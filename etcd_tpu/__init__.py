@@ -1,0 +1,11 @@
+"""etcd_tpu: a TPU-native distributed consistent key-value framework.
+
+Re-imagines etcd (reference at /root/reference) for multi-tenant operation:
+thousands of co-hosted Raft groups stepped as one batched, data-parallel
+consensus kernel on TPU (JAX/XLA/Pallas), with etcd's layering — WAL
+durability, snapshots, v2 store (TTL/CAS/watch), HTTP API, membership,
+proxy, discovery, CLI — preserved around it.
+"""
+
+__version__ = "0.1.0"
+MIN_CLUSTER_VERSION = "2.0.0"

@@ -1,0 +1,149 @@
+"""Deterministic in-memory cluster fixture for tier-1 consensus tests.
+
+Mirrors the reference's test network (raft/raft_test.go:1760-1837): peers
+stepped synchronously, a message queue drained to fixpoint, with drop/cut/
+isolate/ignore fault knobs. Determinism is total — no wall clock, no threads,
+seeded PRNG only — which is also what makes the batched kernel testable
+against this same fixture.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from etcd_tpu import raftpb
+from etcd_tpu.raftpb import Entry, HardState, Message, MessageType, Snapshot
+from etcd_tpu.raft.core import Config, ProposalDroppedError, Raft
+from etcd_tpu.raft.storage import MemoryStorage
+
+
+class BlackHole:
+    """A peer that swallows everything (reference raft_test.go blackHole)."""
+
+    def step(self, m: Message) -> None:
+        pass
+
+    def read_messages(self) -> List[Message]:
+        return []
+
+
+NOP_STEPPER = BlackHole()
+
+
+def new_test_raft(id: int, peers: Sequence[int], election: int,
+                  heartbeat: int, storage: Optional[MemoryStorage] = None,
+                  group: int = 0) -> Raft:
+    storage = storage if storage is not None else MemoryStorage()
+    return Raft(Config(id=id, peers=peers, election_tick=election,
+                       heartbeat_tick=heartbeat, storage=storage,
+                       max_size_per_msg=raftpb.NO_LIMIT,
+                       max_inflight_msgs=256, group=group))
+
+
+def read_messages(r: Union[Raft, BlackHole]) -> List[Message]:
+    if isinstance(r, BlackHole):
+        return []
+    msgs = r.msgs
+    r.msgs = []
+    return msgs
+
+
+def ents_with_terms(*terms: int) -> Raft:
+    """A raft whose log has one entry per given term (reference
+    raft_test.go ents())."""
+    storage = MemoryStorage()
+    storage.append([Entry(index=i + 1, term=t) for i, t in enumerate(terms)])
+    r = new_test_raft(1, [], 5, 1, storage)
+    r.reset(max(terms) if terms else 0)
+    return r
+
+
+class Network:
+    def __init__(self, *peers: Union[Raft, BlackHole, None]) -> None:
+        size = len(peers)
+        ids = id_sequence(size)
+        self.peers: Dict[int, Union[Raft, BlackHole]] = {}
+        self.storage: Dict[int, MemoryStorage] = {}
+        self.dropm: Dict[Tuple[int, int], float] = {}
+        self.ignorem: set = set()
+        self._rng = random.Random(0xE7CD)
+
+        for j, p in enumerate(peers):
+            pid = ids[j]
+            if p is None:
+                self.storage[pid] = MemoryStorage()
+                self.peers[pid] = new_test_raft(pid, ids, 10, 1,
+                                                self.storage[pid])
+            elif isinstance(p, Raft):
+                # Adopt the given raft into this network's id space.
+                p.id = pid
+                if not p.prs:
+                    for i in ids:
+                        p.set_progress(i, 0, p.raft_log.last_index() + 1)
+                self.peers[pid] = p
+            else:
+                self.peers[pid] = p
+
+    def send(self, *msgs: Message) -> None:
+        queue = list(msgs)
+        while queue:
+            m = queue.pop(0)
+            p = self.peers[m.to]
+            try:
+                p.step(m)
+            except ProposalDroppedError:
+                # Dropped proposals surface as errors in our synchronous API;
+                # the network ignores them. Everything else (FSM safety
+                # panics) must fail the test.
+                pass
+            queue.extend(self.filter(read_messages(p)))
+
+    def drop(self, frm: int, to: int, rate: float) -> None:
+        self.dropm[(frm, to)] = rate
+
+    def cut(self, one: int, other: int) -> None:
+        self.drop(one, other, 1.0)
+        self.drop(other, one, 1.0)
+
+    def isolate(self, id: int) -> None:
+        for nid in self.peers:
+            if nid != id:
+                self.cut(id, nid)
+
+    def ignore(self, t: MessageType) -> None:
+        self.ignorem.add(t)
+
+    def recover(self) -> None:
+        self.dropm.clear()
+        self.ignorem.clear()
+
+    def filter(self, msgs: Iterable[Message]) -> List[Message]:
+        out = []
+        for m in msgs:
+            if m.type in self.ignorem:
+                continue
+            if m.type == MessageType.HUP:
+                raise RuntimeError("unexpected MsgHup on the network")
+            rate = self.dropm.get((m.frm, m.to), 0.0)
+            if rate >= 1.0 or (rate > 0 and self._rng.random() < rate):
+                continue
+            out.append(m)
+        return out
+
+
+def id_sequence(n: int) -> List[int]:
+    return list(range(1, n + 1))
+
+
+def next_ents(r: Raft, s: MemoryStorage) -> List[Entry]:
+    """Persist unstable entries into storage and return the newly committed
+    window (reference raft_test.go nextEnts())."""
+    s.append(r.raft_log.unstable_entries())
+    r.raft_log.stable_to(r.raft_log.last_index(), r.raft_log.last_term())
+    ents = r.raft_log.next_ents()
+    r.raft_log.applied_to(r.raft_log.committed)
+    return ents
+
+
+def msg(type: MessageType, frm: int = 0, to: int = 0, **kw) -> Message:
+    return Message(type=type, frm=frm, to=to, **kw)

@@ -1,0 +1,795 @@
+"""Tier-1 consensus core tests: elections, replication, commit, votes,
+membership, snapshots — behavioral port of the reference suite
+(raft/raft_test.go) against the scalar oracle."""
+import pytest
+
+from etcd_tpu import raftpb
+from etcd_tpu.raftpb import (ConfChange, ConfChangeType, ConfState, Entry,
+                             EntryType, HardState, Message, MessageType,
+                             Snapshot, SnapshotMetadata, StateType)
+from etcd_tpu.raft.core import Config, Raft, ProposalDroppedError
+from etcd_tpu.raft.progress import Inflights, Progress, ProgressState
+from etcd_tpu.raft.storage import MemoryStorage
+
+from tests.raft_fixtures import (NOP_STEPPER, Network, ents_with_terms, msg,
+                                 new_test_raft, next_ents, read_messages)
+
+HUP = MessageType.HUP
+PROP = MessageType.PROP
+APP = MessageType.APP
+APP_RESP = MessageType.APP_RESP
+VOTE = MessageType.VOTE
+VOTE_RESP = MessageType.VOTE_RESP
+HEARTBEAT = MessageType.HEARTBEAT
+HEARTBEAT_RESP = MessageType.HEARTBEAT_RESP
+BEAT = MessageType.BEAT
+SNAP = MessageType.SNAP
+
+
+def hup(i):
+    return msg(HUP, frm=i, to=i)
+
+
+def prop(i, data=b"somedata"):
+    return msg(PROP, frm=i, to=i, entries=(Entry(data=data),))
+
+
+# ---------------------------------------------------------------------------
+# Elections
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("peers,expected_state", [
+    ((None, None, None), StateType.LEADER),
+    ((None, None, NOP_STEPPER), StateType.LEADER),
+    ((None, NOP_STEPPER, NOP_STEPPER), StateType.CANDIDATE),
+    ((None, NOP_STEPPER, NOP_STEPPER, None), StateType.CANDIDATE),
+    ((None, NOP_STEPPER, NOP_STEPPER, None, None), StateType.LEADER),
+])
+def test_leader_election(peers, expected_state):
+    nw = Network(*peers)
+    nw.send(hup(1))
+    sm = nw.peers[1]
+    assert sm.state == expected_state
+    assert sm.term == 1
+
+
+def test_leader_election_overwrite_newer_logs():
+    # Three-peer election with a candidate whose log lags: the up-to-date
+    # peer's entries win (log matching / leader completeness).
+    nw = Network(None, None, None)
+    nw.send(hup(1))
+    assert nw.peers[1].state == StateType.LEADER
+    nw.send(prop(1))
+    assert all(nw.peers[i].raft_log.committed == 2 for i in (1, 2, 3))
+
+
+def test_single_node_candidate():
+    nw = Network(None)
+    nw.send(hup(1))
+    assert nw.peers[1].state == StateType.LEADER
+
+
+def test_dueling_candidates():
+    a = new_test_raft(1, [1, 2, 3], 10, 1)
+    b = new_test_raft(2, [1, 2, 3], 10, 1)
+    c = new_test_raft(3, [1, 2, 3], 10, 1)
+    nw = Network(a, b, c)
+    nw.cut(1, 3)
+
+    nw.send(hup(1))
+    nw.send(hup(3))
+    # 1 becomes leader since it receives votes from 1 and 2
+    assert a.state == StateType.LEADER
+    # 3 stays as candidate: it has been denied by both 1 (cut) and 2 (voted)
+    assert c.state == StateType.CANDIDATE
+
+    nw.recover()
+    # Candidate 3 now increases its term and campaigns again; it disrupts the
+    # leader (no prevote in this protocol version) but loses given its shorter
+    # log, conceding to follower on majority rejection.
+    nw.send(hup(3))
+
+    wlog_committed = 1
+    assert a.raft_log.committed == wlog_committed
+    assert a.term == 2
+    assert a.state == StateType.FOLLOWER
+    assert c.term == 2
+    assert c.state == StateType.FOLLOWER
+
+
+def test_candidate_concede():
+    nw = Network(None, None, None)
+    nw.isolate(1)
+    nw.send(hup(1))
+    nw.send(hup(3))
+    nw.recover()
+    # Leader 3 sends a heartbeat + append; candidate 1 concedes.
+    nw.send(msg(BEAT, frm=3, to=3))
+    data = b"force follower"
+    nw.send(msg(PROP, frm=3, to=3, entries=(Entry(data=data),)))
+
+    a = nw.peers[1]
+    assert a.state == StateType.FOLLOWER
+    assert a.term == 1
+    wanted = [Entry(term=1, index=1), Entry(term=1, index=2, data=data)]
+    for i in (1, 2, 3):
+        p = nw.peers[i]
+        assert p.raft_log.committed == 2
+        ents = p.raft_log.all_entries()
+        assert [(e.term, e.index, e.data) for e in ents] == \
+            [(e.term, e.index, e.data) for e in wanted]
+
+
+def test_old_messages():
+    nw = Network(None, None, None)
+    nw.send(hup(1))
+    nw.send(hup(2))
+    nw.send(hup(1))
+    # Pretend we're an old leader trying to make progress; this entry is
+    # expected to be ignored.
+    nw.send(msg(APP, frm=2, to=1, term=2, entries=(Entry(index=3, term=2),)))
+    # Commit a new entry.
+    nw.send(prop(1))
+
+    l = nw.peers[1]
+    ents = l.raft_log.all_entries()
+    terms = [(e.term, e.index) for e in ents]
+    assert terms == [(1, 1), (2, 2), (3, 3), (3, 4)]
+    assert ents[-1].data == b"somedata"
+    assert l.raft_log.committed == 4
+
+
+# ---------------------------------------------------------------------------
+# Proposals / replication
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("peers,success", [
+    ((None, None, None), True),
+    ((None, None, NOP_STEPPER), True),
+    ((None, NOP_STEPPER, NOP_STEPPER), False),
+    ((None, NOP_STEPPER, NOP_STEPPER, None), False),
+    ((None, NOP_STEPPER, NOP_STEPPER, None, None), True),
+])
+def test_proposal(peers, success):
+    nw = Network(*peers)
+    nw.send(hup(1))
+    nw.send(prop(1))
+
+    want_log = [(1, 1), (1, 2)] if success else []
+    for p in nw.peers.values():
+        if not isinstance(p, Raft):
+            continue
+        got = [(e.term, e.index)
+               for e in p.raft_log.all_entries()[:p.raft_log.committed]]
+        assert got == want_log
+    assert nw.peers[1].term == 1
+
+
+def test_proposal_by_proxy():
+    for peers in [(None, None, None), (None, None, NOP_STEPPER)]:
+        nw = Network(*peers)
+        nw.send(hup(1))
+        # Propose via follower 2 — it forwards to leader 1.
+        nw.send(prop(2))
+        for p in nw.peers.values():
+            if not isinstance(p, Raft):
+                continue
+            got = [(e.term, e.index)
+                   for e in p.raft_log.all_entries()[:p.raft_log.committed]]
+            assert got == [(1, 1), (1, 2)]
+        assert nw.peers[1].term == 1
+
+
+def test_log_replication():
+    cases = [
+        (Network(None, None, None), [prop(1)], 2),
+        (Network(None, None, None), [prop(1), hup(2), prop(2)], 4),
+    ]
+    for nw, props, wcommitted in cases:
+        nw.send(hup(1))
+        for m in props:
+            nw.send(m)
+        for i, p in nw.peers.items():
+            assert p.raft_log.committed == wcommitted
+            ents = [e for e in next_ents(p, nw.storage[i]) if e.data]
+            sent_props = [m.entries[0].data for m in props if m.type == PROP]
+            assert [e.data for e in ents] == sent_props
+
+
+def test_single_node_commit():
+    nw = Network(None)
+    nw.send(hup(1))
+    nw.send(prop(1))
+    nw.send(prop(1))
+    assert nw.peers[1].raft_log.committed == 3
+
+
+def test_cannot_commit_without_new_term_entry():
+    # Entries from a previous term cannot be committed by counting replicas
+    # alone (Raft paper §5.4.2).
+    nw = Network(None, None, None, None, None)
+    nw.send(hup(1))
+    # network partition: 1 can no longer reach 3,4,5
+    nw.cut(1, 3)
+    nw.cut(1, 4)
+    nw.cut(1, 5)
+    nw.send(prop(1))
+    nw.send(prop(1))
+    sm = nw.peers[1]
+    assert sm.raft_log.committed == 1
+
+    nw.recover()
+    # Avoid committing ChangeTerm proposals directly via heartbeats.
+    nw.ignore(APP)
+    nw.send(hup(2))
+    sm2 = nw.peers[2]
+    assert sm2.raft_log.committed == 1
+
+    nw.recover()
+    nw.send(msg(BEAT, frm=2, to=2))
+    nw.send(msg(PROP, frm=2, to=2, entries=(Entry(data=b"x"),)))
+    assert sm2.raft_log.committed == 5
+
+
+def test_commit_without_new_term_entry():
+    # ... but a new leader's own-term entry commits everything before it.
+    nw = Network(None, None, None, None, None)
+    nw.send(hup(1))
+    nw.cut(1, 3)
+    nw.cut(1, 4)
+    nw.cut(1, 5)
+    nw.send(prop(1))
+    nw.send(prop(1))
+    assert nw.peers[1].raft_log.committed == 1
+    nw.recover()
+    nw.send(hup(2))
+    assert nw.peers[2].raft_log.committed == 4
+
+
+# ---------------------------------------------------------------------------
+# Commit computation (the quorum median — kernel's hot reduction)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("matches,log_terms,sm_term,w", [
+    # single
+    ([1], [(1, 1)], 1, 1),
+    ([1], [(1, 1)], 2, 0),
+    ([2], [(1, 1), (2, 2)], 2, 2),
+    ([1], [(1, 2)], 2, 1),
+    # odd
+    ([2, 1, 1], [(1, 1), (2, 2)], 1, 1),
+    ([2, 1, 1], [(1, 1), (2, 1)], 2, 0),
+    ([2, 1, 2], [(1, 1), (2, 2)], 2, 2),
+    ([2, 1, 2], [(1, 1), (2, 1)], 2, 0),
+    # even
+    ([2, 1, 1, 1], [(1, 1), (2, 2)], 1, 1),
+    ([2, 1, 1, 1], [(1, 1), (2, 1)], 2, 0),
+    ([2, 1, 1, 2], [(1, 1), (2, 2)], 1, 1),
+    ([2, 1, 1, 2], [(1, 1), (2, 1)], 2, 0),
+    ([2, 1, 2, 2], [(1, 1), (2, 2)], 2, 2),
+    ([2, 1, 2, 2], [(1, 1), (2, 1)], 2, 0),
+])
+def test_commit(matches, log_terms, sm_term, w):
+    storage = MemoryStorage()
+    storage.append([Entry(index=i, term=t) for t, i in
+                    [(t, i) for i, t in log_terms]])
+    storage.set_hard_state(HardState(term=sm_term))
+
+    r = new_test_raft(1, [1], 5, 1, storage)
+    r.term = sm_term
+    for j, m in enumerate(matches):
+        r.set_progress(j + 1, m, m + 1)
+    r.state = StateType.LEADER
+    r.maybe_commit()
+    assert r.raft_log.committed == w
+
+
+def test_is_election_timeout_distribution():
+    # elapsed just past the timeout should trigger ~ proportionally
+    # (reference TestIsElectionTimeout); statistical bounds are loose.
+    for elapse, wprob, round_trip in [
+        (5, 0.0, False), (13, 0.3, True), (15, 0.5, True),
+        (18, 0.8, True), (20, 1.0, False),
+    ]:
+        r = new_test_raft(1, [1], 10, 1)
+        r.elapsed = elapse
+        c = sum(1 for _ in range(10000) if r.is_election_timeout())
+        got = c / 10000.0
+        if round_trip:
+            assert abs(got - wprob) < 0.3
+        elif wprob == 0.0:
+            assert got == 0.0
+        else:
+            assert got > 0.9
+
+
+# ---------------------------------------------------------------------------
+# Step edge cases
+# ---------------------------------------------------------------------------
+
+def test_step_ignore_old_term_msg():
+    called = {"v": False}
+    r = new_test_raft(1, [1], 10, 1)
+
+    def fake_step(m):
+        called["v"] = True
+
+    r._step_fn = fake_step
+    r.term = 2
+    r.step(Message(type=APP, term=1))
+    assert not called["v"]
+
+
+@pytest.mark.parametrize("m,w_index,w_commit,w_reject", [
+    # term mismatch at prev index -> reject
+    (dict(term=2, log_term=3, index=2), 2, 0, True),
+    (dict(term=2, log_term=3, index=3), 2, 0, True),
+    # match
+    (dict(term=2, log_term=1, index=1, commit=1), 2, 1, False),
+    (dict(term=2, log_term=0, index=0, commit=1,
+          entries=(Entry(index=1, term=2),)), 1, 1, False),
+    (dict(term=2, log_term=2, index=2, commit=3,
+          entries=(Entry(index=3, term=2), Entry(index=4, term=2))), 4, 3, False),
+    (dict(term=2, log_term=2, index=2, commit=4,
+          entries=(Entry(index=3, term=2),)), 3, 3, False),
+    (dict(term=2, log_term=1, index=1, commit=4,
+          entries=(Entry(index=2, term=2),)), 2, 2, False),
+    # commit clamps
+    (dict(term=2, log_term=2, index=2, commit=3), 2, 2, False),
+    (dict(term=2, log_term=2, index=2, commit=4), 2, 2, False),
+    (dict(term=2, log_term=2, index=2, commit=0), 2, 0, False),
+])
+def test_handle_msgapp(m, w_index, w_commit, w_reject):
+    storage = MemoryStorage()
+    storage.append([Entry(index=1, term=1), Entry(index=2, term=2)])
+    r = new_test_raft(1, [1], 10, 1, storage)
+    r.become_follower(2, raftpb.NO_LEADER)
+    r.handle_append_entries(Message(type=APP, **m))
+    assert r.raft_log.last_index() == w_index
+    assert r.raft_log.committed == w_commit
+    msgs = read_messages(r)
+    assert len(msgs) == 1
+    assert msgs[0].reject == w_reject
+
+
+def test_handle_heartbeat():
+    commit = 2
+    for m_commit, w_commit in [(commit + 1, commit + 1), (commit - 1, commit)]:
+        storage = MemoryStorage()
+        storage.append([Entry(index=1, term=1), Entry(index=2, term=2),
+                        Entry(index=3, term=3)])
+        r = new_test_raft(1, [1, 2], 5, 1, storage)
+        r.become_follower(2, 2)
+        r.raft_log.commit_to(commit)
+        r.handle_heartbeat(Message(type=HEARTBEAT, frm=2, to=1, term=2,
+                                   commit=m_commit))
+        assert r.raft_log.committed == w_commit
+        msgs = read_messages(r)
+        assert len(msgs) == 1
+        assert msgs[0].type == HEARTBEAT_RESP
+
+
+def test_handle_heartbeat_resp():
+    # Leader re-sends append when follower's match lags after heartbeat resp.
+    storage = MemoryStorage()
+    storage.append([Entry(index=1, term=1), Entry(index=2, term=2),
+                    Entry(index=3, term=3)])
+    r = new_test_raft(1, [1, 2], 5, 1, storage)
+    r.become_candidate()
+    r.become_leader()
+    r.raft_log.commit_to(r.raft_log.last_index())
+
+    r.step(Message(type=HEARTBEAT_RESP, frm=2, term=r.term))
+    msgs = read_messages(r)
+    assert len(msgs) == 1
+    assert msgs[0].type == APP
+
+    # Once the follower is caught up, no more appends on heartbeat resp.
+    r.step(Message(type=APP_RESP, frm=2, term=r.term,
+                   index=msgs[0].index + len(msgs[0].entries)))
+    read_messages(r)
+    r.step(Message(type=HEARTBEAT_RESP, frm=2, term=r.term))
+    assert read_messages(r) == []
+
+
+@pytest.mark.parametrize("state,i,term,vote_for,w_reject", [
+    (StateType.FOLLOWER, 0, 0, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 0, 1, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 0, 2, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 0, 3, raftpb.NO_LEADER, False),
+    (StateType.FOLLOWER, 1, 0, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 1, 1, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 1, 2, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 1, 3, raftpb.NO_LEADER, False),
+    (StateType.FOLLOWER, 2, 0, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 2, 1, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 2, 2, raftpb.NO_LEADER, False),
+    (StateType.FOLLOWER, 2, 3, raftpb.NO_LEADER, False),
+    (StateType.FOLLOWER, 3, 0, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 3, 1, raftpb.NO_LEADER, True),
+    (StateType.FOLLOWER, 3, 2, raftpb.NO_LEADER, False),
+    (StateType.FOLLOWER, 3, 3, raftpb.NO_LEADER, False),
+    (StateType.FOLLOWER, 3, 2, 2, False),
+    (StateType.FOLLOWER, 3, 2, 1, True),
+    (StateType.LEADER, 3, 3, 1, True),
+    (StateType.CANDIDATE, 3, 3, 1, True),
+])
+def test_recv_msgvote(state, i, term, vote_for, w_reject):
+    r = new_test_raft(1, [1], 10, 1)
+    r.state = state
+    r._step_fn = {StateType.FOLLOWER: r._step_follower,
+                  StateType.CANDIDATE: r._step_candidate,
+                  StateType.LEADER: r._step_leader}[state]
+    r.vote = vote_for
+    storage = r.raft_log.storage
+    storage.append([Entry(index=1, term=2), Entry(index=2, term=2)])
+    r.raft_log = type(r.raft_log)(storage)
+
+    r.step(Message(type=VOTE, frm=2, index=i, log_term=term))
+    msgs = read_messages(r)
+    assert len(msgs) == 1
+    assert msgs[0].type == VOTE_RESP
+    assert msgs[0].reject == w_reject
+
+
+@pytest.mark.parametrize("from_state,to_state,wallow,wterm,wlead", [
+    (StateType.FOLLOWER, StateType.FOLLOWER, True, 1, raftpb.NO_LEADER),
+    (StateType.FOLLOWER, StateType.CANDIDATE, True, 1, raftpb.NO_LEADER),
+    (StateType.FOLLOWER, StateType.LEADER, False, 0, raftpb.NO_LEADER),
+    (StateType.CANDIDATE, StateType.FOLLOWER, True, 0, raftpb.NO_LEADER),
+    (StateType.CANDIDATE, StateType.CANDIDATE, True, 1, raftpb.NO_LEADER),
+    (StateType.CANDIDATE, StateType.LEADER, True, 0, 1),
+    (StateType.LEADER, StateType.FOLLOWER, True, 1, raftpb.NO_LEADER),
+    (StateType.LEADER, StateType.CANDIDATE, False, 1, raftpb.NO_LEADER),
+    (StateType.LEADER, StateType.LEADER, True, 0, 1),
+])
+def test_state_transition(from_state, to_state, wallow, wterm, wlead):
+    r = new_test_raft(1, [1], 10, 1)
+    r.state = from_state
+    if from_state == StateType.LEADER:
+        # becomeLeader requires prs self-match bookkeeping; set minimal state.
+        r.prs[1].match = r.raft_log.last_index()
+
+    def do():
+        if to_state == StateType.FOLLOWER:
+            r.become_follower(wterm, wlead)
+        elif to_state == StateType.CANDIDATE:
+            r.become_candidate()
+        else:
+            r.become_leader()
+
+    if not wallow:
+        with pytest.raises(RuntimeError):
+            do()
+    else:
+        do()
+        assert r.term == wterm
+        assert r.lead == wlead
+
+
+def test_all_server_stepdown():
+    cases = [
+        (StateType.FOLLOWER, StateType.FOLLOWER, 3, 0),
+        (StateType.CANDIDATE, StateType.FOLLOWER, 3, 0),
+        (StateType.LEADER, StateType.FOLLOWER, 3, 1),
+    ]
+    tmsg_types = [VOTE, APP]
+    tterm = 3
+    for state, wstate, wterm, windex in cases:
+        r = new_test_raft(1, [1, 2, 3], 10, 1)
+        if state == StateType.CANDIDATE:
+            r.become_candidate()
+        elif state == StateType.LEADER:
+            r.become_candidate()
+            r.become_leader()
+
+        for mt in tmsg_types:
+            r.step(Message(type=mt, frm=2, term=tterm, log_term=tterm))
+            assert r.state == wstate
+            assert r.term == wterm
+            assert r.raft_log.last_index() == windex
+            assert len(r.raft_log.all_entries()) == windex
+            wlead = 2 if mt == APP else raftpb.NO_LEADER
+            assert r.lead == wlead
+
+
+def test_leader_app_resp():
+    # (index, reject, match, next, #msgs, window_index, window_commit)
+    cases = [
+        (3, True, 0, 3, 0, 0, 0),    # stale resp: no replies
+        (2, True, 0, 2, 1, 1, 0),    # denied resp: decrease next, send probe
+        (2, False, 2, 4, 2, 2, 2),   # accepted: commit and broadcast
+        (0, False, 0, 3, 0, 0, 0),   # ignore heartbeat-style resp
+    ]
+    for index, reject, wmatch, wnext, wmsg_num, windex, wcommit in cases:
+        storage = MemoryStorage()
+        storage.append([Entry(index=1, term=0), Entry(index=2, term=1)])
+        r = new_test_raft(1, [1, 2, 3], 10, 1, storage)
+        r.raft_log = type(r.raft_log)(storage)
+        r.become_candidate()
+        r.become_leader()
+        read_messages(r)
+        r.step(Message(type=APP_RESP, frm=2, term=r.term, index=index,
+                       reject=reject, reject_hint=index))
+        p = r.prs[2]
+        assert p.match == wmatch
+        assert p.next == wnext
+        msgs = read_messages(r)
+        assert len(msgs) == wmsg_num
+        for m in msgs:
+            assert m.index == windex
+            assert m.commit == wcommit
+
+
+def test_bcast_beat():
+    # Leader heartbeats attach commit = min(follower.match, committed).
+    offset = 1000
+    s = Snapshot(metadata=SnapshotMetadata(
+        index=offset, term=1, conf_state=ConfState(nodes=(1, 2, 3))))
+    storage = MemoryStorage(snapshot=s)
+    r = new_test_raft(1, [], 10, 1, storage)
+    r.term = 1
+    r.become_candidate()
+    r.become_leader()
+    for i in range(10):
+        r.append_entry(Entry(index=i + 1))
+    r.prs[2].match, r.prs[2].next = 5, 6
+    r.prs[3].match, r.prs[3].next = offset + 10, offset + 11
+    read_messages(r)
+    r.step(Message(type=BEAT, frm=1))
+    msgs = read_messages(r)
+    assert len(msgs) == 2
+    want_commits = {2: min(5, r.raft_log.committed),
+                    3: min(offset + 10, r.raft_log.committed)}
+    for m in msgs:
+        assert m.type == HEARTBEAT
+        assert m.index == 0
+        assert m.log_term == 0
+        assert m.commit == want_commits[m.to]
+        assert not m.entries
+
+
+def test_recv_msgbeat():
+    cases = [(StateType.LEADER, 2), (StateType.CANDIDATE, 0),
+             (StateType.FOLLOWER, 0)]
+    for state, w_msg in cases:
+        storage = MemoryStorage()
+        storage.append([Entry(index=1, term=0), Entry(index=2, term=1)])
+        r = new_test_raft(1, [1, 2, 3], 10, 1, storage)
+        r.raft_log = type(r.raft_log)(storage)
+        r.term = 1
+        r.state = state
+        r._step_fn = {StateType.FOLLOWER: r._step_follower,
+                      StateType.CANDIDATE: r._step_candidate,
+                      StateType.LEADER: r._step_leader}[state]
+        r.step(Message(type=BEAT, frm=1))
+        msgs = read_messages(r)
+        assert len(msgs) == w_msg
+        for m in msgs:
+            assert m.type == HEARTBEAT
+
+
+def test_leader_increase_next():
+    prev_ents = [Entry(term=1, index=1), Entry(term=1, index=2),
+                 Entry(term=1, index=3)]
+    cases = [
+        # replicate state: optimistic next = prev entries + noop + propose + 1
+        (ProgressState.REPLICATE, 2, len(prev_ents) + 2 + 1),
+        # probe state: not advanced
+        (ProgressState.PROBE, 2, 2),
+    ]
+    for state, next_idx, wnext in cases:
+        r = new_test_raft(1, [1, 2], 10, 1)
+        r.raft_log.append(prev_ents)
+        r.become_candidate()
+        r.become_leader()
+        r.prs[2].state = state
+        r.prs[2].next = next_idx
+        r.step(prop(1).type and Message(type=PROP, frm=1,
+                                        entries=(Entry(data=b"d"),)))
+        assert r.prs[2].next == wnext
+
+
+# ---------------------------------------------------------------------------
+# Snapshot install / restore
+# ---------------------------------------------------------------------------
+
+def make_snapshot(index=11, term=11, nodes=(1, 2)):
+    return Snapshot(metadata=SnapshotMetadata(
+        index=index, term=term, conf_state=ConfState(nodes=tuple(nodes))))
+
+
+def test_restore():
+    s = make_snapshot(11, 11, (1, 2, 3))
+    r = new_test_raft(1, [1, 2], 10, 1)
+    assert r.restore(s)
+    assert r.raft_log.last_index() == s.metadata.index
+    assert r.raft_log.term_or_zero(s.metadata.index) == s.metadata.term
+    assert sorted(r.nodes()) == [1, 2, 3]
+    assert not r.restore(s)
+
+
+def test_restore_ignore_snapshot():
+    prev_ents = [Entry(term=1, index=1), Entry(term=1, index=2),
+                 Entry(term=1, index=3)]
+    commit = 1
+    r = new_test_raft(1, [1, 2], 10, 1)
+    r.raft_log.append(prev_ents)
+    r.raft_log.commit_to(commit)
+    s = make_snapshot(commit, 1, (1, 2))
+    # Ignore snapshot at/below committed.
+    assert not r.restore(s)
+    assert r.raft_log.committed == commit
+    # Fast-forward commit when log already matches.
+    s2 = make_snapshot(commit + 1, 1, (1, 2))
+    assert not r.restore(s2)
+    assert r.raft_log.committed == commit + 1
+
+
+def test_provide_snap():
+    s = make_snapshot(11, 11, (1, 2))
+    storage = MemoryStorage()
+    r = new_test_raft(1, [1], 10, 1, storage)
+    r.restore(s)
+    r.become_candidate()
+    r.become_leader()
+    # Force peer 2 behind the first index: leader must send a snapshot.
+    r.prs[2].next = r.raft_log.first_index() - 1
+    r.prs[2].resume()
+    r.step(Message(type=PROP, frm=1, entries=(Entry(data=b"somedata"),)))
+    msgs = read_messages(r)
+    assert len(msgs) == 1
+    assert msgs[0].type == SNAP
+
+
+def test_restore_from_snap_msg():
+    s = make_snapshot(11, 11, (1, 2))
+    m = Message(type=SNAP, frm=1, term=2, snapshot=s)
+    r = new_test_raft(2, [1, 2], 10, 1)
+    r.step(m)
+    assert r.raft_log.last_index() == s.metadata.index
+
+
+def test_slow_node_restore():
+    nw = Network(None, None, None)
+    nw.send(hup(1))
+    nw.isolate(3)
+    for _ in range(101):
+        nw.send(prop(1))
+    lead = nw.peers[1]
+    # Persist + compact the leader's log behind a snapshot.
+    next_ents(lead, nw.storage[1])
+    nw.storage[1].create_snapshot(
+        lead.raft_log.applied, ConfState(nodes=tuple(lead.nodes())), b"")
+    nw.storage[1].compact(lead.raft_log.applied)
+
+    nw.recover()
+    # Send heartbeats until the slow follower 3 reports back; leader then
+    # ships the snapshot.
+    while True:
+        nw.send(msg(BEAT, frm=1, to=1))
+        if lead.prs[3].state != ProgressState.SNAPSHOT:
+            break
+    # Trigger a new proposal so follower 3 fully catches up.
+    nw.send(prop(1))
+    follower = nw.peers[3]
+    assert follower.raft_log.committed == lead.raft_log.committed
+
+
+# ---------------------------------------------------------------------------
+# Membership changes
+# ---------------------------------------------------------------------------
+
+def test_step_config():
+    r = new_test_raft(1, [1, 2], 10, 1)
+    r.become_candidate()
+    r.become_leader()
+    index = r.raft_log.last_index()
+    r.step(Message(type=PROP, frm=1,
+                   entries=(Entry(type=EntryType.CONF_CHANGE),)))
+    assert r.raft_log.last_index() == index + 1
+    assert r.pending_conf
+
+
+def test_step_ignore_config():
+    # Second conf-change proposal while one is pending is demoted to a no-op.
+    r = new_test_raft(1, [1, 2], 10, 1)
+    r.become_candidate()
+    r.become_leader()
+    r.step(Message(type=PROP, frm=1,
+                   entries=(Entry(type=EntryType.CONF_CHANGE),)))
+    index = r.raft_log.last_index()
+    pending = r.pending_conf
+    r.step(Message(type=PROP, frm=1,
+                   entries=(Entry(type=EntryType.CONF_CHANGE),)))
+    wents = [Entry(type=EntryType.NORMAL, term=1, index=3)]
+    ents = r.raft_log.entries(index + 1)
+    assert [(e.type, e.term, e.index, e.data) for e in ents] == \
+        [(e.type, e.term, e.index, e.data) for e in wents]
+    assert r.pending_conf == pending
+
+
+def test_recover_pending_config():
+    for ent_type, wpending in [(EntryType.NORMAL, False),
+                               (EntryType.CONF_CHANGE, True)]:
+        r = new_test_raft(1, [1, 2], 10, 1)
+        r.append_entry(Entry(type=ent_type))
+        r.become_candidate()
+        r.become_leader()
+        assert r.pending_conf == wpending
+
+
+def test_recover_double_pending_config():
+    r = new_test_raft(1, [1, 2], 10, 1)
+    r.append_entry(Entry(type=EntryType.CONF_CHANGE))
+    r.append_entry(Entry(type=EntryType.CONF_CHANGE))
+    r.become_candidate()
+    with pytest.raises(RuntimeError):
+        r.become_leader()
+
+
+def test_add_node():
+    r = new_test_raft(1, [1], 10, 1)
+    r.pending_conf = True
+    r.add_node(2)
+    assert not r.pending_conf
+    assert sorted(r.nodes()) == [1, 2]
+
+
+def test_remove_node():
+    r = new_test_raft(1, [1, 2], 10, 1)
+    r.remove_node(2)
+    assert not r.pending_conf
+    assert r.nodes() == [1]
+    # Removing all nodes is allowed at this layer.
+    r.remove_node(1)
+    assert r.nodes() == []
+
+
+def test_promotable():
+    assert new_test_raft(1, [1], 5, 1).promotable()
+    assert new_test_raft(1, [1, 2, 3], 5, 1).promotable()
+    assert not new_test_raft(1, [2, 3], 5, 1).promotable()
+
+
+def test_campaign_while_leader():
+    r = new_test_raft(1, [1], 5, 1)
+    assert r.state == StateType.FOLLOWER
+    r.step(Message(type=HUP, frm=1))
+    assert r.state == StateType.LEADER
+    term = r.term
+    r.step(Message(type=HUP, frm=1))
+    assert r.state == StateType.LEADER
+    assert r.term == term
+
+
+def test_commit_after_remove_node():
+    # Pending commands can become committed when a node is removed.
+    storage = MemoryStorage()
+    r = new_test_raft(1, [1, 2], 5, 1, storage)
+    r.become_candidate()
+    r.become_leader()
+
+    # Begin to remove node 2.
+    cc = ConfChange(type=ConfChangeType.REMOVE_NODE, node_id=2)
+    r.step(Message(type=PROP, frm=1, entries=(
+        Entry(type=EntryType.CONF_CHANGE, data=raftpb.encode_conf_change(cc)),)))
+    # Stabilize the log and make sure nothing is committed yet.
+    assert not next_ents(r, storage)
+    cc_index = r.raft_log.last_index()
+
+    # A normal proposal while the config change is pending.
+    r.step(Message(type=PROP, frm=1, entries=(Entry(data=b"hello"),)))
+    # Node 2 acknowledges the config change, committing it.
+    r.step(Message(type=APP_RESP, frm=2, term=r.term, index=cc_index))
+    ents = next_ents(r, storage)
+    assert len(ents) == 2
+    assert ents[0].type == EntryType.NORMAL and not ents[0].data
+    assert ents[1].type == EntryType.CONF_CHANGE
+
+    # Apply the config change; the pending command can now commit.
+    r.remove_node(2)
+    ents = next_ents(r, storage)
+    assert len(ents) == 1
+    assert ents[0].type == EntryType.NORMAL
+    assert ents[0].data == b"hello"

@@ -1,0 +1,549 @@
+"""Unit tests for the raft log, unstable tail, MemoryStorage, Progress and
+Inflights (behavioral port of reference log_test.go / log_unstable_test.go /
+storage_test.go / progress-related cases in raft_test.go)."""
+import pytest
+
+from etcd_tpu import raftpb
+from etcd_tpu.raftpb import (ConfState, Entry, HardState, Snapshot,
+                             SnapshotMetadata)
+from etcd_tpu.raft.log import RaftLog, Unstable
+from etcd_tpu.raft.progress import Inflights, Progress, ProgressState
+from etcd_tpu.raft.storage import (CompactedError, MemoryStorage,
+                                   SnapOutOfDateError, UnavailableError)
+
+
+def snap(index, term, nodes=()):
+    return Snapshot(metadata=SnapshotMetadata(
+        index=index, term=term, conf_state=ConfState(nodes=tuple(nodes))))
+
+
+def ents(*pairs):
+    return [Entry(index=i, term=t) for i, t in pairs]
+
+
+# ---------------------------------------------------------------------------
+# MemoryStorage
+# ---------------------------------------------------------------------------
+
+class TestMemoryStorage:
+    def make(self):
+        # Dummy/compaction watermark at (index 3, term 3), live entries 4..5 —
+        # the reference tests build MemoryStorage{ents: {{3,3},{4,4},{5,5}}}.
+        s = MemoryStorage(snapshot=snap(3, 3))
+        s.append(ents((4, 4), (5, 5)))
+        return s
+
+    def test_term(self):
+        s = self.make()
+        with pytest.raises(CompactedError):
+            s.term(2)
+        assert s.term(3) == 3
+        assert s.term(4) == 4
+        assert s.term(5) == 5
+        with pytest.raises(UnavailableError):
+            s.term(6)
+
+    def test_entries(self):
+        s = self.make()
+        with pytest.raises(CompactedError):
+            s.entries(2, 6)
+        with pytest.raises(CompactedError):
+            s.entries(3, 4)
+        assert list(s.entries(4, 5)) == ents((4, 4))
+        assert list(s.entries(4, 6)) == ents((4, 4), (5, 5))
+        # size limits
+        e45 = s.entries(4, 6, max_size=ents((4, 4))[0].size)
+        assert list(e45) == ents((4, 4))
+        # at least one entry even if limit is 0
+        assert len(s.entries(4, 6, max_size=0)) == 1
+
+    def test_last_first_index(self):
+        s = self.make()
+        assert s.last_index() == 5
+        assert s.first_index() == 4
+        s.append(ents((6, 5)))
+        assert s.last_index() == 6
+
+    def test_compact(self):
+        for i, wraise, windex, wterm, wlen in [
+                (2, True, 3, 3, 3), (3, True, 3, 3, 3),
+                (4, False, 4, 4, 2), (5, False, 5, 5, 1)]:
+            s = self.make()
+            if wraise:
+                with pytest.raises(CompactedError):
+                    s.compact(i)
+            else:
+                s.compact(i)
+                assert s._ents[0].index == windex
+                assert s._ents[0].term == wterm
+                assert len(s._ents) == wlen
+
+    def test_create_snapshot(self):
+        cs = ConfState(nodes=(1, 2, 3))
+        data = b"data"
+        s = self.make()
+        sn = s.create_snapshot(4, cs, data)
+        assert sn.metadata.index == 4
+        assert sn.metadata.term == 4
+        assert sn.metadata.conf_state == cs
+        with pytest.raises(SnapOutOfDateError):
+            s.create_snapshot(3, cs, data)
+
+    def test_apply_snapshot(self):
+        s = MemoryStorage()
+        s.apply_snapshot(snap(4, 4, (1, 2, 3)))
+        assert s.first_index() == 5
+        assert s.last_index() == 4
+        with pytest.raises(SnapOutOfDateError):
+            s.apply_snapshot(snap(3, 3))
+
+    def test_append(self):
+        cases = [
+            (ents((1, 1), (2, 2)), ents((3, 3), (4, 4), (5, 5))),   # compacted away
+            (ents((3, 3), (4, 6), (5, 6)), ents((3, 3), (4, 6), (5, 6))),
+            (ents((3, 3), (4, 4), (5, 5), (6, 5)),
+             ents((3, 3), (4, 4), (5, 5), (6, 5))),
+            # truncate incoming entries, truncate the existing, then append
+            (ents((2, 3), (3, 3), (4, 5)), ents((3, 3), (4, 5))),
+            # truncate the existing entries and append
+            (ents((4, 5)), ents((3, 3), (4, 5))),
+            # direct append
+            (ents((6, 5)), ents((3, 3), (4, 4), (5, 5), (6, 5))),
+        ]
+        for to_append, want in cases:
+            s = self.make()
+            s.append(to_append)
+            assert s._ents == want
+
+
+# ---------------------------------------------------------------------------
+# Unstable
+# ---------------------------------------------------------------------------
+
+class TestUnstable:
+    def make(self, offset, entries=(), snapshot=None):
+        u = Unstable(offset)
+        u.entries = list(entries)
+        u.snapshot = snapshot
+        return u
+
+    def test_maybe_first_index(self):
+        assert self.make(5, ents((5, 1))).maybe_first_index() is None
+        assert self.make(0).maybe_first_index() is None
+        assert self.make(5, ents((5, 1)), snap(4, 1)).maybe_first_index() == 5
+        assert self.make(5, (), snap(4, 1)).maybe_first_index() == 5
+
+    def test_maybe_last_index(self):
+        assert self.make(5, ents((5, 1))).maybe_last_index() == 5
+        assert self.make(5, ents((5, 1)), snap(4, 1)).maybe_last_index() == 5
+        assert self.make(5, (), snap(4, 1)).maybe_last_index() == 4
+        assert self.make(0).maybe_last_index() is None
+
+    def test_maybe_term(self):
+        u = self.make(5, ents((5, 1)), snap(4, 1))
+        assert u.maybe_term(3) is None
+        assert u.maybe_term(4) == 1
+        assert u.maybe_term(5) == 1
+        assert u.maybe_term(6) is None
+        u2 = self.make(5, ents((5, 1)))
+        assert u2.maybe_term(4) is None
+        assert u2.maybe_term(5) == 1
+
+    def test_restore(self):
+        u = self.make(5, ents((5, 1)), snap(4, 1))
+        s = snap(6, 2)
+        u.restore(s)
+        assert u.offset == 7
+        assert u.entries == []
+        assert u.snapshot == s
+
+    def test_stable_to(self):
+        cases = [
+            (0, (), None, 5, 0, 0),
+            # stable to the first entry
+            (5, ents((5, 1)), None, 5, 1, 6, 0),
+        ]
+        # exercise directly:
+        u = self.make(5, ents((5, 1)))
+        u.stable_to(5, 1)
+        assert u.offset == 6 and len(u.entries) == 0
+        u = self.make(5, ents((5, 1), (6, 1)))
+        u.stable_to(5, 1)
+        assert u.offset == 6 and len(u.entries) == 1
+        # stable to an old term entry: ignored
+        u = self.make(6, ents((6, 2)))
+        u.stable_to(6, 1)
+        assert u.offset == 6 and len(u.entries) == 1
+        # stable to an unknown index: ignored
+        u = self.make(5, ents((5, 1)))
+        u.stable_to(4, 1)
+        assert u.offset == 5 and len(u.entries) == 1
+        # with snapshot
+        u = self.make(5, ents((5, 1)), snap(4, 1))
+        u.stable_to(5, 1)
+        assert u.offset == 6 and len(u.entries) == 0
+
+    def test_truncate_and_append(self):
+        # append beyond
+        u = self.make(5, ents((5, 1)))
+        u.truncate_and_append(ents((6, 1), (7, 1)))
+        assert u.entries == ents((5, 1), (6, 1), (7, 1))
+        # replace
+        u = self.make(5, ents((5, 1)))
+        u.truncate_and_append(ents((5, 2), (6, 2)))
+        assert u.offset == 5 and u.entries == ents((5, 2), (6, 2))
+        u = self.make(5, ents((5, 1)))
+        u.truncate_and_append(ents((4, 2), (5, 2), (6, 2)))
+        assert u.offset == 4 and u.entries == ents((4, 2), (5, 2), (6, 2))
+        # truncate then append
+        u = self.make(5, ents((5, 1), (6, 1), (7, 1)))
+        u.truncate_and_append(ents((6, 2)))
+        assert u.offset == 5 and u.entries == ents((5, 1), (6, 2))
+
+
+# ---------------------------------------------------------------------------
+# RaftLog
+# ---------------------------------------------------------------------------
+
+class TestRaftLog:
+    def test_find_conflict(self):
+        prev = ents((1, 1), (2, 2), (3, 3))
+        cases = [
+            ((), 0),
+            (ents((1, 1), (2, 2), (3, 3)), 0),
+            (ents((2, 2), (3, 3)), 0),
+            (ents((3, 3)), 0),
+            # no conflict with new entries
+            (ents((1, 1), (2, 2), (3, 3), (4, 4), (5, 4)), 4),
+            (ents((4, 4), (5, 4)), 4),
+            # conflicts
+            (ents((1, 4), (2, 4)), 1),
+            (ents((2, 1), (3, 4), (4, 4)), 2),
+            (ents((3, 1), (4, 2), (5, 4), (6, 4)), 3),
+        ]
+        for case_ents, wconflict in cases:
+            log = RaftLog(MemoryStorage())
+            log.append(prev)
+            assert log.find_conflict(case_ents) == wconflict
+
+    def test_is_up_to_date(self):
+        log = RaftLog(MemoryStorage())
+        log.append(ents((1, 1), (2, 2), (3, 3)))
+        cases = [
+            # greater term always up to date
+            (log.last_index() - 1, 4, True),
+            (log.last_index(), 4, True),
+            (log.last_index() + 1, 4, True),
+            # smaller term never
+            (log.last_index() - 1, 2, False),
+            (log.last_index(), 2, False),
+            (log.last_index() + 1, 2, False),
+            # equal term: index decides
+            (log.last_index() - 1, 3, False),
+            (log.last_index(), 3, True),
+            (log.last_index() + 1, 3, True),
+        ]
+        for lasti, term, w in cases:
+            assert log.is_up_to_date(lasti, term) == w
+
+    def test_append(self):
+        cases = [
+            (ents((3, 2)), 3, ents((1, 1), (2, 2), (3, 2)), 3),
+            ((), 2, ents((1, 1), (2, 2)), 3),
+            # conflicts with index 1
+            (ents((1, 2)), 1, ents((1, 2)), 1),
+            # conflicts with index 2
+            (ents((2, 3), (3, 3)), 3, ents((1, 1), (2, 3), (3, 3)), 2),
+        ]
+        for app, windex, wents, wunstable in cases:
+            storage = MemoryStorage()
+            storage.append(ents((1, 1), (2, 2)))
+            log = RaftLog(storage)
+            assert log.append(app) == windex
+            assert log.entries(1) == wents
+            assert log.unstable.offset == wunstable
+
+    def test_maybe_append(self):
+        last_index, last_term, commit = 3, 3, 1
+        cases = [
+            # not match: term differs
+            (dict(index=last_index, log_term=last_term - 1,
+                  committed=last_index, ents=ents((last_index + 1, 4))),
+             None, commit),
+            # not match: index out of bound
+            (dict(index=last_index + 1, log_term=last_term,
+                  committed=last_index, ents=ents((last_index + 2, 4))),
+             None, commit),
+            # match at last
+            (dict(index=last_index, log_term=last_term,
+                  committed=last_index, ents=()), last_index, last_index),
+            (dict(index=last_index, log_term=last_term,
+                  committed=last_index + 1, ents=ents((last_index + 1, 4))),
+             last_index + 1, last_index + 1),
+            (dict(index=last_index, log_term=last_term,
+                  committed=last_index + 2, ents=ents((last_index + 1, 4))),
+             last_index + 1, last_index + 1),  # commit clamps to lastnewi
+            (dict(index=last_index, log_term=last_term,
+                  committed=last_index + 2,
+                  ents=ents((last_index + 1, 4), (last_index + 2, 4))),
+             last_index + 2, last_index + 2),
+            # match earlier
+            (dict(index=last_index - 1, log_term=last_term - 1,
+                  committed=last_index, ents=ents((last_index, 4))),
+             last_index, last_index),
+            (dict(index=0, log_term=0, committed=last_index, ents=()),
+             0, commit),  # commit stays (lastnewi=0 clamps)
+        ]
+        for kw, wlasti, wcommit in cases:
+            log = RaftLog(MemoryStorage())
+            log.append(ents((1, 1), (2, 2), (3, 3)))
+            log.committed = commit
+            got = log.maybe_append(kw["index"], kw["log_term"],
+                                   kw["committed"], kw["ents"])
+            assert got == wlasti
+            assert log.committed == wcommit
+
+    def test_maybe_append_conflict_below_commit_panics(self):
+        log = RaftLog(MemoryStorage())
+        log.append(ents((1, 1), (2, 2), (3, 3)))
+        log.committed = 3
+        with pytest.raises(RuntimeError):
+            log.maybe_append(1, 1, 3, ents((2, 4), (3, 4)))
+
+    def test_compaction_side_effects(self):
+        # All entries remain reachable after storage compaction.
+        last_index = 1000
+        unstable_index = 750
+        storage = MemoryStorage()
+        storage.append(ents(*[(i, i) for i in range(1, unstable_index + 1)]))
+        log = RaftLog(storage)
+        log.append(ents(*[(i, i) for i in range(unstable_index + 1,
+                                                last_index + 1)]))
+        assert log.maybe_commit(last_index, last_index)
+        log.applied_to(log.committed)
+
+        offset = 500
+        storage.compact(offset)
+        assert log.last_index() == last_index
+        for j in range(offset, log.last_index() + 1):
+            assert log.term_or_zero(j) == j
+            assert log.match_term(j, j)
+        unstable_ents = log.unstable_entries()
+        assert len(unstable_ents) == 250
+        assert unstable_ents[0].index == 751
+
+        prev = log.last_index()
+        log.append([Entry(index=prev + 1, term=prev + 1)])
+        assert log.last_index() == prev + 1
+        assert log.entries(log.last_index()) == [Entry(index=prev + 1,
+                                                       term=prev + 1)]
+
+    def test_next_ents(self):
+        sn = snap(3, 1)
+        entries = ents((4, 1), (5, 1), (6, 1))
+        for applied, window in [
+                (0, entries[:2]), (3, entries[:2]), (4, entries[1:2]), (5, [])]:
+            storage = MemoryStorage(snapshot=sn)
+            log = RaftLog(storage)
+            log.append(entries)
+            log.maybe_commit(5, 1)
+            log.applied_to(applied)
+            assert log.next_ents() == window
+
+    def test_unstable_ents(self):
+        prev = ents((1, 1), (2, 2))
+        for unstable_from, wents in [(3, []), (1, prev)]:
+            storage = MemoryStorage()
+            storage.append(prev[:unstable_from - 1])
+            log = RaftLog(storage)
+            log.append(prev[unstable_from - 1:])
+            uents = log.unstable_entries()
+            assert uents == wents
+            if uents:
+                log.stable_to(uents[-1].index, uents[-1].term)
+            assert log.unstable.offset == len(prev) + 1
+
+    def test_commit_to(self):
+        log = RaftLog(MemoryStorage())
+        log.append(ents((1, 1), (2, 2), (3, 3)))
+        log.committed = 2
+        log.commit_to(3)
+        assert log.committed == 3
+        log.commit_to(1)
+        assert log.committed == 3  # never decreases
+        with pytest.raises(RuntimeError):
+            log.commit_to(4)
+
+    def test_stable_to_with_snap(self):
+        snapi, snapt = 5, 2
+        cases = [
+            ((snapi + 1, snapt), [], snapi + 1),
+            ((snapi, snapt), [], snapi + 1),
+            ((snapi - 1, snapt), [], snapi + 1),
+            ((snapi + 1, snapt + 1), [], snapi + 1),
+            ((snapi + 1, snapt), ents((snapi + 1, snapt)), snapi + 2),
+            ((snapi, snapt), ents((snapi + 1, snapt)), snapi + 1),
+        ]
+        for (stablei, stablet), new_ents, wunstable in cases:
+            storage = MemoryStorage(snapshot=snap(snapi, snapt))
+            log = RaftLog(storage)
+            log.append(new_ents)
+            log.stable_to(stablei, stablet)
+            assert log.unstable.offset == wunstable
+
+    def test_compaction(self):
+        # (lastIndex, compactTo, wleft)
+        cases = [
+            (1000, [300, 500, 800, 900], [700, 500, 200, 100]),
+            (1000, [300, 299], [700, -1]),  # second compact is out of range
+        ]
+        for last_index, compacts, wleft in cases:
+            storage = MemoryStorage()
+            storage.append(ents(*[(i, i) for i in range(1, last_index + 1)]))
+            log = RaftLog(storage)
+            log.maybe_commit(last_index, last_index)
+            log.applied_to(log.committed)
+            for compact_to, w in zip(compacts, wleft):
+                if w == -1:
+                    with pytest.raises(CompactedError):
+                        storage.compact(compact_to)
+                else:
+                    storage.compact(compact_to)
+                    assert len(log.all_entries()) == w
+
+    def test_restore(self):
+        index, term = 1000, 1000
+        log = RaftLog(MemoryStorage(snapshot=snap(index, term)))
+        assert log.all_entries() == []
+        assert log.first_index() == index + 1
+        assert log.committed == index
+        assert log.unstable.offset == index + 1
+        assert log.term_or_zero(index) == term
+
+    def test_slice(self):
+        offset, num = 100, 100
+        last = offset + num
+        half = offset + num // 2
+        storage = MemoryStorage(snapshot=snap(offset, 0))
+        storage.append(ents(*[(offset + i, offset + i)
+                              for i in range(1, num // 2)]))
+        log = RaftLog(storage)
+        log.append(ents(*[(half + i, half + i) for i in range(num // 2)]))
+
+        with pytest.raises(CompactedError):
+            log.slice(offset - 1, offset + 1)
+        with pytest.raises(CompactedError):
+            log.slice(offset, offset + 1)
+        assert list(log.slice(half - 1, half + 1)) == \
+            ents((half - 1, half - 1), (half, half))
+        with pytest.raises(ValueError):
+            log.slice(last, last + 2)
+        # size-limited
+        one = log.slice(half - 1, half + 1,
+                        max_size=ents((half - 1, half - 1))[0].size)
+        assert list(one) == ents((half - 1, half - 1))
+
+
+# ---------------------------------------------------------------------------
+# Progress / Inflights
+# ---------------------------------------------------------------------------
+
+class TestProgress:
+    def test_maybe_update(self):
+        prev_m, prev_n = 3, 5
+        cases = [
+            (prev_m - 1, False, prev_m, prev_n),    # stale
+            (prev_m, False, prev_m, prev_n),
+            (prev_m + 1, True, prev_m + 1, prev_n),  # advance match
+            (prev_m + 2, True, prev_m + 2, prev_n + 1),  # advance both
+        ]
+        for update, wok, wm, wn in cases:
+            p = Progress(match=prev_m, next=prev_n)
+            assert p.maybe_update(update) == wok
+            assert p.match == wm
+            assert p.next == wn
+
+    def test_maybe_decr(self):
+        cases = [
+            # replicate state: rejected <= match is stale
+            (ProgressState.REPLICATE, 5, 10, 5, 5, False, 10),
+            (ProgressState.REPLICATE, 5, 10, 4, 4, False, 10),
+            (ProgressState.REPLICATE, 5, 10, 9, 9, True, 6),
+            # probe state: rejected != next-1 is stale
+            (ProgressState.PROBE, 0, 0, 0, 0, False, 0),
+            (ProgressState.PROBE, 0, 10, 5, 5, False, 10),
+            (ProgressState.PROBE, 0, 10, 9, 9, True, 9),
+            (ProgressState.PROBE, 0, 2, 1, 1, True, 1),
+            (ProgressState.PROBE, 0, 1, 0, 0, True, 1),
+            (ProgressState.PROBE, 0, 10, 9, 2, True, 3),
+            (ProgressState.PROBE, 0, 10, 9, 0, True, 1),
+        ]
+        for state, m, n, rejected, last, w, wn in cases:
+            p = Progress(match=m, next=n)
+            p.state = state
+            assert p.maybe_decr_to(rejected, last) == w
+            assert p.match == m
+            assert p.next == wn
+
+    def test_is_paused(self):
+        cases = [
+            (ProgressState.PROBE, False, False),
+            (ProgressState.PROBE, True, True),
+            (ProgressState.REPLICATE, False, False),
+            (ProgressState.SNAPSHOT, False, True),
+            (ProgressState.SNAPSHOT, True, True),
+        ]
+        for state, paused, w in cases:
+            p = Progress(inflight_size=256)
+            p.state = state
+            p.paused = paused
+            assert p.is_paused() == w
+
+    def test_resume(self):
+        p = Progress(next=2)
+        p.paused = True
+        p.maybe_decr_to(1, 1)
+        assert not p.paused
+        p.paused = True
+        p.maybe_update(2)
+        assert not p.paused
+
+    def test_become_transitions(self):
+        p = Progress(match=1, next=5, inflight_size=256)
+        p.become_snapshot(10)
+        assert p.state == ProgressState.SNAPSHOT
+        assert p.pending_snapshot == 10
+        p.become_probe()
+        assert p.state == ProgressState.PROBE
+        assert p.next == 11  # max(match+1, pending+1)
+        p.become_replicate()
+        assert p.state == ProgressState.REPLICATE
+        assert p.next == p.match + 1
+
+
+class TestInflights:
+    def test_add_and_full(self):
+        ins = Inflights(10)
+        for i in range(10):
+            ins.add(i)
+        assert ins.full()
+        with pytest.raises(RuntimeError):
+            ins.add(10)
+
+    def test_free_to(self):
+        ins = Inflights(10)
+        for i in range(10):
+            ins.add(i)
+        ins.free_to(4)
+        assert ins.count() == 5
+        assert not ins.full()
+        ins.free_to(9)
+        assert ins.count() == 0
+
+    def test_free_first_one(self):
+        ins = Inflights(10)
+        for i in range(10):
+            ins.add(i)
+        ins.free_first_one()
+        assert ins.count() == 9
+        assert ins.buffer[0] == 1

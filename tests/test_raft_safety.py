@@ -1,0 +1,160 @@
+"""Randomized safety properties over the scalar oracle: seeded simulations of
+a 3/5-peer cluster with message drops, duplicates and partitions, checking the
+Raft safety invariants after every delivery. This is the oracle-validation
+layer the batched kernel is later property-tested against (tier-1 strategy,
+SURVEY.md §4)."""
+import random
+
+import pytest
+
+from etcd_tpu.raftpb import Entry, Message, MessageType, StateType
+from etcd_tpu.raft.core import Raft
+from tests.raft_fixtures import new_test_raft, read_messages
+
+
+def check_election_safety(peers):
+    """At most one leader per term."""
+    leaders = {}
+    for p in peers.values():
+        if p.state == StateType.LEADER:
+            assert p.term not in leaders, (
+                f"two leaders in term {p.term}: {leaders[p.term]} and {p.id}")
+            leaders[p.term] = p.id
+
+
+def check_log_matching(peers):
+    """If two logs contain an entry with the same index and term, the logs
+    are identical up through that index."""
+    plist = list(peers.values())
+    for i in range(len(plist)):
+        for j in range(i + 1, len(plist)):
+            a, b = plist[i], plist[j]
+            hi = min(a.raft_log.last_index(), b.raft_log.last_index())
+            match_at = 0
+            for idx in range(hi, 0, -1):
+                if (a.raft_log.term_or_zero(idx)
+                        == b.raft_log.term_or_zero(idx) != 0):
+                    match_at = idx
+                    break
+            for idx in range(1, match_at + 1):
+                ta = a.raft_log.term_or_zero(idx)
+                tb = b.raft_log.term_or_zero(idx)
+                assert ta == tb, (
+                    f"log matching violated at index {idx}: "
+                    f"peer {a.id} term {ta} vs peer {b.id} term {tb}")
+
+
+def check_leader_completeness(peers, committed_prefix):
+    """Committed entries never disappear or change term."""
+    for p in peers.values():
+        for idx, term in committed_prefix.items():
+            if idx <= p.raft_log.committed:
+                got = p.raft_log.term_or_zero(idx)
+                assert got == term, (
+                    f"peer {p.id} committed entry {idx} has term {got}, "
+                    f"expected {term}")
+
+
+@pytest.mark.parametrize("n_peers,seed", [(3, 1), (3, 2), (3, 3),
+                                          (5, 4), (5, 5)])
+def test_safety_under_chaos(n_peers, seed):
+    rng = random.Random(seed)
+    ids = list(range(1, n_peers + 1))
+    peers = {i: new_test_raft(i, ids, 10, 1, group=seed) for i in ids}
+    in_flight = []
+    committed_prefix = {}  # index -> term, as first observed committed
+    proposals = 0
+
+    def pump(p):
+        for m in read_messages(p):
+            in_flight.append(m)
+
+    for step in range(3000):
+        action = rng.random()
+        if action < 0.55 and in_flight:
+            # Deliver a random in-flight message (out-of-order network).
+            m = in_flight.pop(rng.randrange(len(in_flight)))
+            if rng.random() < 0.12:
+                continue  # drop
+            if rng.random() < 0.06:
+                in_flight.append(m)  # duplicate delivery later
+            target = peers.get(m.to)
+            if target is not None:
+                try:
+                    target.step(m)
+                except Exception as e:
+                    if "no leader" not in str(e):
+                        raise
+                pump(target)
+        elif action < 0.8:
+            # Tick a random peer.
+            p = peers[rng.choice(ids)]
+            p.tick()
+            pump(p)
+        else:
+            # Propose on a random peer (may be dropped if no leader).
+            p = peers[rng.choice(ids)]
+            proposals += 1
+            try:
+                p.step(Message(type=MessageType.PROP, frm=p.id,
+                               entries=(Entry(data=b"d%d" % proposals),)))
+            except Exception as e:
+                if "no leader" not in str(e):
+                    raise
+            pump(p)
+
+        # Record newly committed entries and check invariants.
+        for p in peers.values():
+            for idx in range(1, p.raft_log.committed + 1):
+                t = p.raft_log.term_or_zero(idx)
+                if idx not in committed_prefix and t != 0:
+                    committed_prefix[idx] = t
+        check_election_safety(peers)
+        check_log_matching(peers)
+        check_leader_completeness(peers, committed_prefix)
+
+    # Liveness sanity: with this much activity someone must have committed.
+    assert max(p.raft_log.committed for p in peers.values()) > 0
+
+
+def test_liveness_after_partition_heals():
+    rng = random.Random(42)
+    ids = [1, 2, 3]
+    peers = {i: new_test_raft(i, ids, 10, 1) for i in ids}
+    in_flight = []
+
+    def pump(p):
+        for m in read_messages(p):
+            in_flight.append(m)
+
+    def run(steps, blocked=()):
+        for _ in range(steps):
+            if in_flight and rng.random() < 0.7:
+                m = in_flight.pop(0)
+                if m.to in blocked or m.frm in blocked:
+                    continue
+                t = peers.get(m.to)
+                if t is not None:
+                    t.step(m)
+                    pump(t)
+            else:
+                p = peers[rng.choice(ids)]
+                p.tick()
+                pump(p)
+
+    run(200)
+    leaders = [p for p in peers.values() if p.state == StateType.LEADER]
+    assert len(leaders) == 1
+    old_leader = leaders[0]
+
+    # Partition the leader away; the rest must elect a new one.
+    run(400, blocked={old_leader.id})
+    others = [p for p in peers.values() if p.id != old_leader.id]
+    new_leaders = [p for p in others if p.state == StateType.LEADER]
+    assert len(new_leaders) == 1
+    assert new_leaders[0].term > old_leader.term or \
+        old_leader.state != StateType.LEADER
+
+    # Heal: the old leader rejoins and converges to follower of the new term.
+    run(400)
+    check_election_safety(peers)
