@@ -1,0 +1,127 @@
+"""Headline benchmark: aggregate Raft commits/sec across G groups on one chip.
+
+Reproduces BASELINE.json config 4's shape (default 100k groups x 5 peers,
+uniform writes) with the batched consensus kernel: every round is ONE XLA
+program stepping all G x P instances (tick + message delivery + proposals +
+quorum commit + send assembly), with message routing a device-side transpose.
+
+Baseline for vs_baseline: the reference's best published write throughput,
+4,157 writes/sec (256B values, 256 clients, leader-only — BASELINE.md,
+Documentation/benchmarks/etcd-2-1-0-benchmarks.md:46). One committed entry
+here == one write there (payloads ride the host log store; the device commits
+index metadata, which is the consensus bottleneck being measured).
+
+Env knobs: BENCH_GROUPS (default 100000), BENCH_PEERS (5), BENCH_ROUNDS
+(200 measured), BENCH_WARM_ROUNDS. Prints ONE JSON line on stdout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    G = int(os.environ.get("BENCH_GROUPS", 100_000))
+    P = int(os.environ.get("BENCH_PEERS", 5))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 200))
+    warm = int(os.environ.get("BENCH_WARM_ROUNDS", 30))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:
+        log(f"primary backend unavailable ({e}); falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+    log(f"devices: {devs}")
+
+    from etcd_tpu.ops import kernel
+    from etcd_tpu.ops.state import LEADER, KernelConfig, init_state
+
+    cfg = KernelConfig(groups=G, peers=P, window=16, max_ents=4,
+                       election_tick=10, heartbeat_tick=3)
+    st = init_state(cfg)
+    inbox = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+    zero = jnp.zeros(G, jnp.int32)
+
+    # --- Phase 1: elect every group's leader -----------------------------
+    t0 = time.time()
+    for r in range(2000):
+        st, outbox = kernel.step(cfg, st, inbox, zero, zero,
+                                 jnp.asarray(True))
+        inbox = kernel.route_local(outbox)
+        if r % 25 == 24:
+            state = np.asarray(st.state)
+            missing = int((np.sum(state == LEADER, axis=1) == 0).sum())
+            log(f"round {r + 1}: {G - missing}/{G} groups have leaders")
+            if missing == 0:
+                break
+    state = np.asarray(st.state)
+    if (np.sum(state == LEADER, axis=1) == 0).any():
+        log("FATAL: elections did not converge")
+        return 1
+    log(f"elections converged in {time.time() - t0:.1f}s")
+
+    slots = jnp.asarray((state == LEADER).argmax(axis=1).astype(np.int32))
+    full = jnp.full(G, cfg.max_ents, jnp.int32)
+
+    def commits_now(st):
+        c = np.asarray(st.commit)
+        s = np.asarray(slots)
+        return int(c[np.arange(G), s].sum())
+
+    # --- Phase 2: steady-state proposal load -----------------------------
+    for _ in range(warm):
+        st, outbox = kernel.step(cfg, st, inbox, full, slots,
+                                 jnp.asarray(True))
+        inbox = kernel.route_local(outbox)
+    jax.block_until_ready(st.commit)
+
+    start_commits = commits_now(st)
+    times = []
+    t0 = time.time()
+    for r in range(rounds):
+        t_r = time.time()
+        st, outbox = kernel.step(cfg, st, inbox, full, slots,
+                                 jnp.asarray(True))
+        inbox = kernel.route_local(outbox)
+        jax.block_until_ready(inbox)
+        times.append(time.time() - t_r)
+    elapsed = time.time() - t0
+    end_commits = commits_now(st)
+
+    commits = end_commits - start_commits
+    cps = commits / elapsed
+    round_ms = 1000.0 * elapsed / rounds
+    p99_round = 1000.0 * float(np.percentile(times, 99))
+    # A proposal needs one round to replicate (APP out) and one to ack
+    # (APP_RESP back + quorum commit): commit latency ~= 2 rounds.
+    p99_commit_ms = 2.0 * p99_round
+
+    log(f"G={G} P={P}: {commits} commits in {elapsed:.2f}s over {rounds} "
+        f"rounds ({round_ms:.2f} ms/round, p99 {p99_round:.2f} ms) -> "
+        f"{cps:,.0f} commits/s, est p99 commit latency {p99_commit_ms:.2f} ms")
+
+    baseline = 4157.0
+    print(json.dumps({
+        "metric": f"aggregate_commits_per_sec_{G}_groups_{P}_peers",
+        "value": round(cps, 1),
+        "unit": "commits/s",
+        "vs_baseline": round(cps / baseline, 2),
+        "p99_commit_latency_ms": round(p99_commit_ms, 2),
+        "round_ms": round(round_ms, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

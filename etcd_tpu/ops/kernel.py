@@ -1,0 +1,594 @@
+"""The batched consensus kernel: G Raft groups × P peer slots stepped as one
+XLA program.
+
+This replaces the reference's per-group goroutine loops (raft.MultiNode,
+raft/multinode.go:166-322 — including the O(groups) tick scan flagged at
+multinode.go:265-267) with dense array transforms:
+
+- tick scan            -> vectorized elapsed/timeout update over (G, P)
+- Step(m) per message  -> masked updates, one unrolled pass per sender slot
+- maybeCommit sort     -> lax.top_k over the peers axis (raft/raft.go:323-332)
+- bcastAppend/sendAppend -> gap-driven send assembly over the (G, P, P)
+                            progress matrix (raft/raft.go:239-321)
+- message routing      -> a transpose of the (G, P_from, P_to) outbox
+                          (single host) or an all_to_all over the "peers"
+                          mesh axis (distributed; etcd_tpu/parallel)
+
+Design rules (why this diverges from a line-for-line port):
+1. Message LOSS is always legal in Raft, so the dense mailbox keeps exactly
+   one slot per (sender, target) pair and drops lower-priority collisions
+   (response > append > heartbeat > vote) — the protocol retries via
+   timeouts. This is what makes the mailbox a fixed-shape tensor.
+2. Sends are gap-driven rather than event-driven: at the end of each step a
+   leader emits an append to any unpaused follower whose `next` lags. This
+   subsumes the reference's bcast-on-propose / send-on-ack triggers and
+   needs no per-event control flow.
+3. Rare/heavy transitions (snapshot install+send, conf change application,
+   appends below the device log window) escape to the host scalar oracle
+   (etcd_tpu/raft/core.py) via `need_host` flags; the hot path stays static.
+4. Flow control is entries-in-flight (`next-1-match >= flow_window`) instead
+   of the reference's message-count ring (progress.go:172-237): with one
+   coalesced append per (peer, round), window-by-entries is the natural
+   dense form.
+
+Election timing is bit-identical to the scalar oracle: same xorshift32
+streams, same draw points (reference raft.go:765-771 semantics).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from etcd_tpu.ops.state import (CANDIDATE, FOLLOWER, F_COMMIT, F_HINT,
+                                F_INDEX, F_LOGTERM, F_NENT, F_REJECT, F_TERM,
+                                F_TYPE, GroupState, KernelConfig, LEADER,
+                                M_APP, M_APP_RESP, M_HB, M_HB_RESP, M_NONE,
+                                M_VOTE, M_VOTE_RESP, N_FIXED_FIELDS,
+                                PR_PROBE, PR_REPLICATE, active_mask,
+                                in_window, quorum, term_at, xorshift32)
+
+
+def _where(m, a, b):
+    return jnp.where(m, a, b)
+
+
+def _last_term(st: GroupState, cfg: KernelConfig) -> jax.Array:
+    return term_at(st, cfg, st.last_index)
+
+
+def _set_self_progress(st: GroupState) -> GroupState:
+    """Leader's own match tracks its last index (reference appendEntry ->
+    prs[self].maybeUpdate)."""
+    G, P = st.term.shape
+    eye = jnp.eye(P, dtype=bool)[None, :, :]
+    is_ldr = (st.state == LEADER)[..., None]
+    match = _where(eye & is_ldr, st.last_index[..., None], st.match)
+    nxt = _where(eye & is_ldr, st.last_index[..., None] + 1, st.next)
+    return st._replace(match=match, next=nxt)
+
+
+def _become_follower(st: GroupState, mask: jax.Array, new_term: jax.Array,
+                     new_lead: jax.Array) -> GroupState:
+    """Masked becomeFollower(term, lead) (reference raft.go:384-391 +
+    reset()); vote cleared only when the term actually changes."""
+    term_changed = mask & (new_term != st.term)
+    return st._replace(
+        term=_where(mask, new_term, st.term),
+        vote=_where(term_changed, 0, st.vote),
+        lead=_where(mask, new_lead, st.lead),
+        state=_where(mask, FOLLOWER, st.state),
+        elapsed=_where(mask, 0, st.elapsed),
+        votes=_where(mask[..., None], 0, st.votes),
+    )
+
+
+def _append_noop_and_lead(st: GroupState, cfg: KernelConfig,
+                          win: jax.Array) -> GroupState:
+    """Masked becomeLeader: reset progress, append the no-op entry of the new
+    term (reference raft.go:406-427)."""
+    G, P = st.term.shape
+    new_last = st.last_index + 1
+    slot = jnp.mod(new_last, cfg.window)
+    log_term = _where(
+        win[..., None],
+        st.log_term.at[
+            jnp.arange(G)[:, None, None],
+            jnp.arange(P)[None, :, None],
+            slot[..., None],
+        ].set(st.term[..., None]),
+        st.log_term)
+    st = st._replace(
+        state=_where(win, LEADER, st.state),
+        lead=_where(win, jnp.arange(1, P + 1, dtype=jnp.int32)[None, :],
+                    st.lead),
+        elapsed=_where(win, 0, st.elapsed),
+        last_index=_where(win, new_last, st.last_index),
+        log_term=log_term,
+        # Progress reset: probe from the PRE-no-op last+1 (= new_last), as
+        # the reference's reset() runs before appendEntry — so the no-op
+        # itself replicates to quiescent followers.
+        match=_where(win[..., None], 0, st.match),
+        next=_where(win[..., None], new_last[..., None], st.next),
+        pr_state=_where(win[..., None], PR_PROBE, st.pr_state),
+        paused=_where(win[..., None], False, st.paused),
+    )
+    return _set_self_progress(st)
+
+
+# ---------------------------------------------------------------------------
+# Phase 1: tick
+# ---------------------------------------------------------------------------
+
+def _tick(st: GroupState, cfg: KernelConfig,
+          active: jax.Array) -> Tuple[GroupState, jax.Array, jax.Array]:
+    """Advance the logical clock one tick for every instance. Returns
+    (state, hb_fire_term, vote_fire_term): (G, P) int32 arrays holding the
+    term at which a heartbeat broadcast / vote broadcast was staged this
+    round (0 = none) — the term lets send assembly cancel the broadcast if a
+    same-round message bumped us off that term."""
+    G, P = st.term.shape
+    is_ldr = st.state == LEADER
+    elapsed = st.elapsed + 1
+
+    # Leaders: heartbeat timeout (reference tickHeartbeat raft.go:376-382).
+    hb_timeout = active & is_ldr & (elapsed >= cfg.heartbeat_tick)
+    hb_fire_term = _where(hb_timeout, st.term, 0)
+
+    # Followers/candidates: randomized election timeout (reference
+    # tickElection + isElectionTimeout raft.go:362-373,765-771).
+    d = elapsed - cfg.election_tick
+    draw = active & ~is_ldr & (d >= 0)
+    prng = _where(draw, xorshift32(st.prng), st.prng)
+    timeout = draw & (d > (prng % jnp.uint32(cfg.election_tick)).astype(jnp.int32))
+
+    st = st._replace(
+        prng=prng,
+        elapsed=_where(hb_timeout | timeout, 0, elapsed),
+    )
+
+    # Campaign (reference campaign() raft.go:429-443): term+1, vote self,
+    # tally own vote; single-voter groups win instantly.
+    camp = timeout
+    self_id = jnp.arange(1, P + 1, dtype=jnp.int32)[None, :]
+    votes = _where(camp[..., None], 0, st.votes)
+    votes = _where(
+        camp[..., None] & (jnp.arange(P)[None, None, :]
+                           == jnp.arange(P)[None, :, None]),
+        1, votes)
+    st = st._replace(
+        term=_where(camp, st.term + 1, st.term),
+        vote=_where(camp, self_id, st.vote),
+        lead=_where(camp, 0, st.lead),
+        state=_where(camp, CANDIDATE, st.state),
+        votes=votes,
+        # reset() also clears progress; leaders-to-be re-reset on winning.
+        paused=_where(camp[..., None], False, st.paused),
+    )
+    instant_win = camp & (quorum(st)[:, None] == 1)
+    st = _append_noop_and_lead(st, cfg, instant_win)
+    vote_fire_term = _where(camp & ~instant_win, st.term, 0)
+
+    # Heartbeat broadcast resumes all paused probes (reference
+    # bcastHeartbeat raft.go:313-321).
+    st = st._replace(paused=_where(hb_timeout[..., None], False, st.paused))
+    return st, hb_fire_term, vote_fire_term
+
+
+# ---------------------------------------------------------------------------
+# Phase 2: one sender slot's messages, for all instances at once
+# ---------------------------------------------------------------------------
+
+def _step_msgs_from(st: GroupState, cfg: KernelConfig, q: int,
+                    msg: jax.Array, active: jax.Array,
+                    ) -> Tuple[GroupState, jax.Array]:
+    """Process the inbox slot from sender `q` on every instance; returns the
+    updated state and the staged response (G, P, F) addressed back to q.
+
+    Mirrors raft.Step (reference raft.go:462-669) as masked dense updates.
+    """
+    G, P = st.term.shape
+    F = cfg.fields
+    mtype = msg[..., F_TYPE]
+    mterm = msg[..., F_TERM]
+    mindex = msg[..., F_INDEX]
+    mlogterm = msg[..., F_LOGTERM]
+    mcommit = msg[..., F_COMMIT]
+    mreject = msg[..., F_REJECT]
+    mhint = msg[..., F_HINT]
+    mnent = msg[..., F_NENT]
+    ent_terms = msg[..., N_FIXED_FIELDS:]
+
+    has = active & (mtype != M_NONE)
+    resp = jnp.zeros((G, P, F), jnp.int32)
+
+    # -- term gate (reference raft.go:470-486) -----------------------------
+    higher = has & (mterm > st.term)
+    lead_on_higher = _where(mtype == M_VOTE, 0, q + 1)
+    st = _become_follower(st, higher, mterm, lead_on_higher)
+    live = has & (mterm == st.term)  # stale (lower-term) messages ignored
+
+    is_f = st.state == FOLLOWER
+    is_c = st.state == CANDIDATE
+    is_l = st.state == LEADER
+
+    # -- MsgApp / MsgHeartbeat demote same-term candidates (stepCandidate) --
+    demote = live & is_c & ((mtype == M_APP) | (mtype == M_HB))
+    st = _become_follower(st, demote, st.term, q + 1)
+    is_f, is_c, is_l = (st.state == FOLLOWER, st.state == CANDIDATE,
+                        st.state == LEADER)
+
+    # -- MsgVote (uniform grant rule; reference stepFollower raft.go:636-647,
+    #    leaders/candidates reject naturally because vote == self) ----------
+    v = live & (mtype == M_VOTE)
+    last_t = _last_term(st, cfg)
+    up_to_date = (mlogterm > last_t) | ((mlogterm == last_t)
+                                        & (mindex >= st.last_index))
+    grant = v & ((st.vote == 0) | (st.vote == q + 1)) & up_to_date
+    st = st._replace(
+        vote=_where(grant, q + 1, st.vote),
+        elapsed=_where(grant, 0, st.elapsed),
+    )
+    resp = _stage(resp, v, M_VOTE_RESP, st.term, reject=~grant)
+
+    # -- MsgVoteResp (reference stepCandidate raft.go:603-612) --------------
+    vr = live & is_c & (mtype == M_VOTE_RESP)
+    first = st.votes[:, :, q] == 0
+    vote_val = _where(mreject == 0, 1, 2)
+    votes = st.votes.at[:, :, q].set(
+        _where(vr & first, vote_val, st.votes[:, :, q]))
+    st = st._replace(votes=votes)
+    granted = jnp.sum((votes == 1).astype(jnp.int32), axis=2)
+    rejected = jnp.sum((votes == 2).astype(jnp.int32), axis=2)
+    qr = quorum(st)[:, None]
+    win = vr & (granted >= qr)
+    lose = vr & ~win & (rejected >= qr)
+    st = _append_noop_and_lead(st, cfg, win)
+    st = _become_follower(st, lose, st.term, 0)
+    is_f, is_c, is_l = (st.state == FOLLOWER, st.state == CANDIDATE,
+                        st.state == LEADER)
+
+    # -- MsgApp (reference handleAppendEntries raft.go:651-664) -------------
+    a = live & (mtype == M_APP) & ~is_l
+    st = st._replace(
+        elapsed=_where(a, 0, st.elapsed),
+        lead=_where(a, q + 1, st.lead),
+    )
+    below_commit = a & (mindex < st.commit)
+    resp = _stage(resp, below_commit, M_APP_RESP, st.term,
+                  index=st.commit)
+
+    chk = a & ~below_commit
+    prev_t = term_at(st, cfg, mindex)
+    prev_in_win = in_window(st, cfg, mindex)
+    # Below the device window (but >= commit): the host resolves it.
+    escape = chk & ~prev_in_win & (mindex <= st.last_index)
+    st = st._replace(need_host=st.need_host | escape)
+
+    match_ok = chk & ~escape & prev_in_win & (prev_t == mlogterm)
+    rej = chk & ~escape & ~match_ok
+    resp = _stage(resp, rej, M_APP_RESP, st.term, index=mindex,
+                  reject=True, hint=st.last_index)
+
+    # Conflict scan + append over the E entry slots (reference
+    # findConflict/truncateAndAppend log.go:98-123).
+    E = cfg.max_ents
+    idx_j = mindex[..., None] + 1 + jnp.arange(E, dtype=jnp.int32)[None, None]
+    valid_j = jnp.arange(E)[None, None] < mnent[..., None]
+    my_t = _terms_at_many(st, cfg, idx_j)
+    mismatch = valid_j & (my_t != ent_terms)
+    any_conf = match_ok & jnp.any(mismatch, axis=-1)
+    first_j = jnp.argmax(mismatch, axis=-1)
+    ci = _where(any_conf, mindex + 1 + first_j, 0)
+    # Safety: conflicting with a committed entry is a protocol violation
+    # (reference log.go maybeAppend panic); route to host for diagnosis.
+    st = st._replace(need_host=st.need_host | (any_conf & (ci <= st.commit)))
+
+    do_append = any_conf
+    write_j = do_append[..., None] & valid_j & (idx_j >= ci[..., None])
+    st = _write_terms(st, cfg, idx_j, ent_terms, write_j)
+    lastnewi = mindex + mnent
+    st = st._replace(
+        last_index=_where(do_append, lastnewi, st.last_index))
+    new_commit = jnp.maximum(st.commit,
+                             jnp.minimum(mcommit, lastnewi))
+    st = st._replace(commit=_where(match_ok, new_commit, st.commit))
+    resp = _stage(resp, match_ok, M_APP_RESP, st.term, index=lastnewi)
+
+    # -- MsgAppResp (reference stepLeader raft.go:514-546) ------------------
+    ar = live & is_l & (mtype == M_APP_RESP)
+    match_q = st.match[:, :, q]
+    next_q = st.next[:, :, q]
+    pr_q = st.pr_state[:, :, q]
+    paused_q = st.paused[:, :, q]
+
+    rej_resp = ar & (mreject != 0)
+    # replicate: fall back to match+1 and probe (maybeDecrTo fast path)
+    repl_rej = rej_resp & (pr_q == PR_REPLICATE) & (mindex > match_q)
+    # probe: only the outstanding probe at next-1 counts
+    probe_rej = rej_resp & (pr_q == PR_PROBE) & (next_q - 1 == mindex)
+    next_q = _where(repl_rej, match_q + 1, next_q)
+    next_q = _where(probe_rej,
+                    jnp.maximum(jnp.minimum(mindex, mhint + 1), 1), next_q)
+    pr_q = _where(repl_rej, PR_PROBE, pr_q)
+    paused_q = _where(probe_rej, False, paused_q)
+
+    ok_resp = ar & (mreject == 0)
+    upd = ok_resp & (match_q < mindex)
+    match_q = _where(upd, mindex, match_q)
+    paused_q = _where(upd, False, paused_q)
+    pr_q = _where(upd & (pr_q == PR_PROBE), PR_REPLICATE, pr_q)
+    next_q = jnp.maximum(next_q, _where(ok_resp, mindex + 1, 0))
+
+    st = st._replace(
+        match=st.match.at[:, :, q].set(match_q),
+        next=st.next.at[:, :, q].set(next_q),
+        pr_state=st.pr_state.at[:, :, q].set(pr_q),
+        paused=st.paused.at[:, :, q].set(paused_q),
+    )
+
+    # -- MsgHeartbeat (reference handleHeartbeat raft.go:666-669) -----------
+    h = live & (mtype == M_HB) & ~is_l
+    st = st._replace(
+        elapsed=_where(h, 0, st.elapsed),
+        lead=_where(h, q + 1, st.lead),
+        commit=_where(h, jnp.maximum(st.commit,
+                                     jnp.minimum(mcommit, st.last_index)),
+                      st.commit),
+    )
+    resp = _stage(resp, h, M_HB_RESP, st.term)
+
+    # -- MsgHeartbeatResp: gap-driven sends + BEAT-resume make it a no-op ---
+    return st, resp
+
+
+def _stage(resp: jax.Array, mask: jax.Array, mtype: int, term: jax.Array,
+           index=None, reject=None, hint=None) -> jax.Array:
+    """Write a response message into `resp` (G, P, F) where mask holds.
+    Later stages win slot collisions, matching sequential Step semantics
+    (each message produces at most one response in the scalar core)."""
+    upd = resp
+    upd = upd.at[..., F_TYPE].set(jnp.where(mask, mtype, upd[..., F_TYPE]))
+    upd = upd.at[..., F_TERM].set(jnp.where(mask, term, upd[..., F_TERM]))
+    if index is not None:
+        upd = upd.at[..., F_INDEX].set(
+            jnp.where(mask, index, upd[..., F_INDEX]))
+    if reject is not None:
+        rej = jnp.asarray(reject)
+        upd = upd.at[..., F_REJECT].set(
+            jnp.where(mask, rej.astype(jnp.int32), upd[..., F_REJECT]))
+    if hint is not None:
+        upd = upd.at[..., F_HINT].set(jnp.where(mask, hint, upd[..., F_HINT]))
+    return upd
+
+
+def _terms_at_many(st: GroupState, cfg: KernelConfig,
+                   idx: jax.Array) -> jax.Array:
+    """term_at for an extra trailing axis of indices: idx (G, P, E) ->
+    terms (G, P, E); 0 outside the window / beyond last."""
+    slot = jnp.mod(idx, cfg.window)
+    t = jnp.take_along_axis(st.log_term, slot, axis=2)
+    last = st.last_index[..., None]
+    valid = (idx > last - cfg.window) & (idx <= last) & (idx >= 1)
+    return jnp.where(valid, t, 0)
+
+
+def _write_terms(st: GroupState, cfg: KernelConfig, idx: jax.Array,
+                 terms: jax.Array, mask: jax.Array) -> GroupState:
+    """Scatter entry terms into the log ring at absolute indices idx (G,P,E)
+    where mask holds."""
+    G, P, E = idx.shape
+    slot = jnp.mod(idx, cfg.window)
+    gi = jnp.broadcast_to(jnp.arange(G)[:, None, None], (G, P, E))
+    pi = jnp.broadcast_to(jnp.arange(P)[None, :, None], (G, P, E))
+    cur = jnp.take_along_axis(st.log_term, slot, axis=2)
+    new = jnp.where(mask, terms, cur)
+    return st._replace(log_term=st.log_term.at[gi, pi, slot].set(new))
+
+
+# ---------------------------------------------------------------------------
+# Phase 3: proposals
+# ---------------------------------------------------------------------------
+
+def _apply_proposals(st: GroupState, cfg: KernelConfig, prop_count: jax.Array,
+                     prop_slot: jax.Array, active: jax.Array) -> GroupState:
+    """The addressed leader appends `prop_count[g]` new entries of its term
+    (reference appendEntry raft.go:351-360; payloads live in the host log
+    store). `prop_slot[g]` names the slot the host routed the proposals to —
+    during a transient two-leader window only that instance appends, so the
+    host's (group, index)->payload map stays unambiguous."""
+    P = st.term.shape[1]
+    is_target = jnp.arange(P, dtype=jnp.int32)[None, :] == prop_slot[:, None]
+    is_ldr = active & is_target & (st.state == LEADER)
+    # Admission control: never let the uncommitted tail outrun half the
+    # device log window, or followers' needed entries fall off the ring and
+    # every group degrades to the host snapshot path. This is the batched
+    # analogue of the reference's proposal backpressure (its raft channel
+    # blocks; here the device itself throttles and the host engine retries
+    # unaccepted proposals next round).
+    tail = st.last_index - st.commit
+    room = jnp.maximum(0, cfg.window // 2 - tail)
+    cnt = jnp.minimum(jnp.minimum(prop_count[:, None], cfg.max_ents), room)
+    cnt = cnt * is_ldr.astype(jnp.int32)
+    E = cfg.max_ents
+    idx_j = st.last_index[..., None] + 1 + jnp.arange(E, dtype=jnp.int32)[None, None]
+    write_j = jnp.arange(E)[None, None] < cnt[..., None]
+    terms = jnp.broadcast_to(st.term[..., None], idx_j.shape)
+    st = _write_terms(st, cfg, idx_j, terms, write_j)
+    st = st._replace(last_index=st.last_index + cnt)
+    return _set_self_progress(st)
+
+
+# ---------------------------------------------------------------------------
+# Phase 4: quorum commit (THE reduction — reference maybeCommit
+# raft.go:323-332 becomes one top_k over the peers axis)
+# ---------------------------------------------------------------------------
+
+def _quorum_commit(st: GroupState, cfg: KernelConfig,
+                   active: jax.Array) -> GroupState:
+    G, P = st.term.shape
+    eye = jnp.eye(P, dtype=bool)[None, :, :]
+    target_active = active[:, None, :]
+    mrow = _where(eye, st.last_index[..., None], st.match)
+    mrow = _where(target_active, mrow, -1)
+    topk, _ = jax.lax.top_k(mrow, P)  # sorted descending
+    qidx = (quorum(st) - 1)[:, None, None]
+    mci = jnp.take_along_axis(topk, jnp.broadcast_to(qidx, (G, P, 1)),
+                              axis=2)[..., 0]
+    # Only entries from the leader's own term commit by counting
+    # (raftLog.maybeCommit; Raft paper §5.4.2).
+    mci_term = term_at(st, cfg, jnp.maximum(mci, 0))
+    ok = (st.state == LEADER) & (mci > st.commit) & (mci_term == st.term)
+    return st._replace(commit=_where(ok, mci, st.commit))
+
+
+# ---------------------------------------------------------------------------
+# Phase 5: send assembly (gap-driven)
+# ---------------------------------------------------------------------------
+
+def _assemble_sends(st: GroupState, cfg: KernelConfig, resp: jax.Array,
+                    hb_fire_term: jax.Array, vote_fire_term: jax.Array,
+                    active: jax.Array) -> Tuple[GroupState, jax.Array]:
+    """Build the outbox (G, P_from, P_to, F) and apply optimistic progress
+    updates for sent appends."""
+    G, P = st.term.shape
+    F = cfg.fields
+    E = cfg.max_ents
+    eye = jnp.eye(P, dtype=bool)[None, :, :]
+    tgt_ok = active[:, None, :] & active[:, :, None] & ~eye
+
+    # ---- appends --------------------------------------------------------
+    is_ldr = (st.state == LEADER)[..., None]
+    last = st.last_index[..., None]
+    unacked = st.next - 1 - st.match
+    paused_eff = _where(st.pr_state == PR_PROBE, st.paused,
+                        unacked >= cfg.flow_window)
+    has_gap = st.next <= last
+    prev = st.next - 1
+    prev_in_win = in_window(st, cfg, prev)
+    # Target lags below the device window -> host must ship a snapshot.
+    need_snap = is_ldr & tgt_ok & has_gap & ~prev_in_win
+    st = st._replace(need_host=st.need_host | jnp.any(need_snap, axis=2))
+
+    send_app = is_ldr & tgt_ok & has_gap & ~paused_eff & prev_in_win
+    n = jnp.minimum(last - st.next + 1, E)
+    n = _where(send_app, n, 0)
+
+    # Entry terms for slots next .. next+n-1, gathered from the log ring.
+    idx_e = st.next[..., None] + jnp.arange(E, dtype=jnp.int32)[None, None, None]
+    slot_e = jnp.mod(idx_e, cfg.window)
+    ring = jnp.broadcast_to(st.log_term[:, :, None, :], (G, P, P, cfg.window))
+    terms_e = jnp.take_along_axis(ring, slot_e, axis=3)
+    valid_e = jnp.arange(E)[None, None, None] < n[..., None]
+    terms_e = jnp.where(valid_e, terms_e, 0)
+
+    prev_term = _terms_at_many(st, cfg, prev)  # (G, P, P): per-sender ring
+
+    out = jnp.zeros((G, P, P, F), jnp.int32)
+    term_b = jnp.broadcast_to(st.term[..., None], (G, P, P))
+    commit_b = jnp.broadcast_to(st.commit[..., None], (G, P, P))
+
+    def put(out, mask, field, val):
+        return out.at[..., field].set(jnp.where(mask, val, out[..., field]))
+
+    out = put(out, send_app, F_TYPE, M_APP)
+    out = put(out, send_app, F_TERM, term_b)
+    out = put(out, send_app, F_INDEX, prev)
+    out = put(out, send_app, F_LOGTERM, prev_term)
+    out = put(out, send_app, F_COMMIT, commit_b)
+    out = put(out, send_app, F_NENT, n)
+    ents_cur = out[..., N_FIXED_FIELDS:]
+    out = out.at[..., N_FIXED_FIELDS:].set(
+        jnp.where(send_app[..., None], terms_e, ents_cur))
+
+    # Optimistic update / probe pause (reference sendAppend raft.go:267-279).
+    sent_n = _where(send_app, n, 0)
+    st = st._replace(
+        next=_where(send_app & (st.pr_state == PR_REPLICATE),
+                    st.next + sent_n, st.next),
+        paused=_where(send_app & (st.pr_state == PR_PROBE), True, st.paused),
+    )
+
+    # ---- heartbeats (lower priority than appends) -----------------------
+    hb_ok = (hb_fire_term[..., None] == term_b) & (hb_fire_term[..., None] > 0)
+    send_hb = is_ldr & tgt_ok & hb_ok & ~send_app
+    hb_commit = jnp.minimum(st.match, commit_b)  # reference raft.go:285-298
+    out = put(out, send_hb, F_TYPE, M_HB)
+    out = put(out, send_hb, F_TERM, term_b)
+    out = put(out, send_hb, F_COMMIT, hb_commit)
+
+    # ---- vote requests --------------------------------------------------
+    is_cand = (st.state == CANDIDATE)[..., None]
+    vf = (vote_fire_term[..., None] == term_b) & (vote_fire_term[..., None] > 0)
+    send_vote = is_cand & tgt_ok & vf & (out[..., F_TYPE] == M_NONE)
+    last_t = _last_term(st, cfg)
+    out = put(out, send_vote, F_TYPE, M_VOTE)
+    out = put(out, send_vote, F_TERM, term_b)
+    out = put(out, send_vote, F_INDEX,
+              jnp.broadcast_to(last[..., 0][..., None], (G, P, P)))
+    out = put(out, send_vote, F_LOGTERM,
+              jnp.broadcast_to(last_t[..., None], (G, P, P)))
+
+    # ---- responses override everything (drop-on-collision is safe) ------
+    has_resp = resp[..., F_TYPE] != M_NONE
+    out = jnp.where(has_resp[..., None], resp, out)
+    return st, out
+
+
+# ---------------------------------------------------------------------------
+# The step
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def step(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
+         prop_count: jax.Array, prop_slot: jax.Array, tick: jax.Array
+         ) -> Tuple[GroupState, jax.Array]:
+    """One batched consensus round for all G×P instances.
+
+    inbox:      (G, P, P_from, F) int32 — inbox[g, p, q] is the message
+                delivered to instance (g, p) from sender slot q this round
+                (M_NONE-typed slots are empty).
+    prop_count: (G,) int32 — entries proposed to each group's leader this
+                round (payloads stay in the host log store).
+    prop_slot:  (G,) int32 — which peer slot the host routed proposals to.
+    tick:       () bool — whether this round advances the logical clock.
+
+    Returns (new_state, outbox) with outbox (G, P_to_assignment...) shaped
+    (G, P_from, P_to, F). Routing outbox->inbox is a transpose of the two
+    peer axes (single host) or an all_to_all over the "peers" mesh axis.
+
+    Phase order (the scalar equivalence harness mirrors it exactly):
+    tick -> messages by sender slot 0..P-1 -> proposals -> quorum commit ->
+    send assembly.
+    """
+    active = active_mask(st)
+    P = st.term.shape[1]
+
+    def do_tick(st):
+        return _tick(st, cfg, active)
+
+    def no_tick(st):
+        z = jnp.zeros_like(st.term)
+        return st, z, z
+
+    st, hb_fire, vote_fire = jax.lax.cond(tick, do_tick, no_tick, st)
+
+    resp = jnp.zeros((st.term.shape[0], P, P, cfg.fields), jnp.int32)
+    for q in range(P):  # unrolled: P is small and static
+        st, r = _step_msgs_from(st, cfg, q, inbox[:, :, q, :], active)
+        resp = resp.at[:, :, q, :].set(r)
+
+    st = _apply_proposals(st, cfg, prop_count, prop_slot, active)
+    st = _quorum_commit(st, cfg, active)
+    st, outbox = _assemble_sends(st, cfg, resp, hb_fire, vote_fire, active)
+    return st, outbox
+
+
+def route_local(outbox: jax.Array) -> jax.Array:
+    """Single-host message routing: outbox[g, from, to] -> inbox[g, to, from]
+    is just a transpose of the peer axes — the entire rafthttp layer
+    (reference rafthttp/, 4187 lines) collapses to this when peers are
+    co-located as array rows."""
+    return jnp.swapaxes(outbox, 1, 2)

@@ -1,0 +1,187 @@
+"""Dense struct-of-arrays state for the batched consensus kernel.
+
+This is the TPU-native re-expression of the reference's per-goroutine state
+(raft struct raft/raft.go:125-155, Progress map raft/progress.go:37-67,
+raftLog raft/log.go:24-39): G groups × P peer slots stepped as ONE XLA
+program. Layout conventions:
+
+- Arrays are shaped (G, P, ...) — group axis first (shardable over the mesh
+  "groups" axis), peer-slot axis second (local in single-host mode, sharded
+  over the mesh "peers" axis in the distributed deployment).
+- Peer slots are 0-based; `vote`/`lead` fields store slot+1 with 0 = none
+  (mirroring the reference's None=0 node id convention).
+- The on-device log is a fixed ring of entry TERMS addressed by absolute
+  index modulo WINDOW (entry i lives at slot i % W); entry payloads never
+  touch the device — they stay in the host log store (the msgappv2 insight,
+  reference rafthttp/msgappv2.go:29-63: the hot path is index bookkeeping).
+- All state is int32 (uint32 for the xorshift PRNG lanes); indices are
+  int32 which bounds a single group's log index at 2^31 — compaction keeps
+  real indices far below this.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Roles (shared with etcd_tpu.raftpb.StateType).
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+# Progress states (shared with etcd_tpu.raft.progress.ProgressState);
+# SNAPSHOT transfers are host-side, so the device only tracks probe/replicate.
+PR_PROBE, PR_REPLICATE = 0, 1
+
+# Kernel message types (dense codes; NONE=0 means empty slot).
+M_NONE, M_APP, M_APP_RESP, M_VOTE, M_VOTE_RESP, M_HB, M_HB_RESP = range(7)
+
+# Message field offsets in the last axis of inbox/outbox arrays.
+F_TYPE, F_TERM, F_INDEX, F_LOGTERM, F_COMMIT, F_REJECT, F_HINT, F_NENT = range(8)
+N_FIXED_FIELDS = 8
+
+
+class KernelConfig(NamedTuple):
+    """Static (compile-time) parameters of the batched kernel."""
+
+    groups: int            # G
+    peers: int             # P: padded peer-slot count (>= max group size)
+    window: int = 16       # W: on-device log ring length (uncommitted tail cap)
+    max_ents: int = 4      # E: max entries per append message
+    election_tick: int = 10
+    heartbeat_tick: int = 1
+    flow_window: int = 1024  # max un-acked entries per follower (replicate)
+
+    @property
+    def fields(self) -> int:
+        return N_FIXED_FIELDS + self.max_ents
+
+
+class GroupState(NamedTuple):
+    """SoA consensus state; a JAX pytree. Shapes in comments use
+    G=groups, P=peer slots, W=window, E=max_ents."""
+
+    # Per-instance HardState/SoftState (reference raftpb HardState +
+    # raft.lead/state):
+    term: jax.Array          # (G, P) int32
+    vote: jax.Array          # (G, P) int32, slot+1, 0 = none
+    commit: jax.Array        # (G, P) int32
+    lead: jax.Array          # (G, P) int32, slot+1, 0 = none
+    state: jax.Array         # (G, P) int32 in {FOLLOWER, CANDIDATE, LEADER}
+
+    # Tick machinery (reference raft.go:149-152,765-771):
+    elapsed: jax.Array       # (G, P) int32
+    prng: jax.Array          # (G, P) uint32 xorshift32 lanes
+
+    # On-device log: ring of entry terms + cursors (reference raftLog):
+    log_term: jax.Array      # (G, P, W) int32; entry i at slot i % W
+    last_index: jax.Array    # (G, P) int32
+
+    # Leader replication state, per target slot (reference Progress):
+    match: jax.Array         # (G, P, P) int32
+    next: jax.Array          # (G, P, P) int32
+    pr_state: jax.Array      # (G, P, P) int32 in {PR_PROBE, PR_REPLICATE}
+    paused: jax.Array        # (G, P, P) bool (probe in-flight pause)
+
+    # Candidate vote tally (reference raft.votes): 0 unknown / 1 granted /
+    # 2 rejected, per voter slot:
+    votes: jax.Array         # (G, P, P) int32
+
+    # Membership: number of active peer slots per group (slots 0..n-1 live).
+    n_peers: jax.Array       # (G,) int32
+
+    # Host-escape flags: group needs the scalar slow path (snapshot send,
+    # append below the device window, safety check failure).
+    need_host: jax.Array     # (G, P) bool
+
+
+def _seed(groups: int, peers: int) -> np.ndarray:
+    """Per-(group, slot) xorshift32 seeds, identical to the scalar oracle's
+    prng_seed(group, node_id=slot+1) (etcd_tpu/raft/core.py)."""
+    g = np.arange(groups, dtype=np.uint64)[:, None]
+    p = np.arange(1, peers + 1, dtype=np.uint64)[None, :]
+    s = (g * np.uint64(0x9E3779B9) + p * np.uint64(0x85EBCA6B) + np.uint64(1))
+    s = (s & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    s[s == 0] = 1
+    return s
+
+
+def init_state(cfg: KernelConfig, n_peers=None) -> GroupState:
+    """Fresh-boot state: every instance a follower at term 0 with an empty
+    log. `n_peers` may be an int (uniform group size) or a (G,) array."""
+    G, P = cfg.groups, cfg.peers
+    if n_peers is None:
+        n_peers = P
+    n_peers_arr = jnp.array(np.broadcast_to(np.asarray(n_peers, np.int32),
+                                            (G,)))
+
+    # Each field gets its OWN buffer: step() donates the whole state pytree,
+    # and XLA rejects donating one buffer twice.
+    def zeros_gp():
+        return jnp.zeros((G, P), jnp.int32)
+
+    def zeros_gpp():
+        return jnp.zeros((G, P, P), jnp.int32)
+
+    return GroupState(
+        term=zeros_gp(),
+        vote=zeros_gp(),
+        commit=zeros_gp(),
+        lead=zeros_gp(),
+        state=zeros_gp(),
+        elapsed=zeros_gp(),
+        prng=jnp.asarray(_seed(G, P)),
+        log_term=jnp.zeros((G, P, cfg.window), jnp.int32),
+        last_index=zeros_gp(),
+        match=zeros_gpp(),
+        next=jnp.ones((G, P, P), jnp.int32),
+        pr_state=zeros_gpp(),
+        paused=jnp.zeros((G, P, P), bool),
+        votes=zeros_gpp(),
+        n_peers=n_peers_arr,
+        need_host=jnp.zeros((G, P), bool),
+    )
+
+
+def active_mask(st: GroupState) -> jax.Array:
+    """(G, P) bool: which peer slots exist."""
+    P = st.term.shape[1]
+    return jnp.arange(P, dtype=jnp.int32)[None, :] < st.n_peers[:, None]
+
+
+def quorum(st: GroupState) -> jax.Array:
+    """(G,) int32: n//2 + 1 (reference raft.go:215)."""
+    return st.n_peers // 2 + 1
+
+
+def term_at(st: GroupState, cfg: KernelConfig, index: jax.Array) -> jax.Array:
+    """Term of entry `index` per instance; 0 for index 0 (the empty-log
+    sentinel) and for indices outside the device window (callers must treat
+    out-of-window as escape-to-host where it matters).
+
+    index: (G, P) absolute entry indices. Returns (G, P) int32.
+    """
+    slot = jnp.mod(index, cfg.window)
+    t = jnp.take_along_axis(st.log_term, slot[..., None], axis=2)[..., 0]
+    in_window = (index > st.last_index - cfg.window) & (index <= st.last_index)
+    valid = in_window & (index >= 1)
+    return jnp.where(valid, t, 0)
+
+
+def in_window(st: GroupState, cfg: KernelConfig, index: jax.Array) -> jax.Array:
+    """bool mask: entry `index` is resolvable on device (or is index 0).
+    `index` may be (G, P) or carry extra trailing axes ((G, P, K))."""
+    last = st.last_index
+    while last.ndim < index.ndim:
+        last = last[..., None]
+    return ((index > last - cfg.window) & (index <= last)) | (index == 0)
+
+
+def xorshift32(x: jax.Array) -> jax.Array:
+    """Vectorized Marsaglia xorshift32, bit-identical to the scalar oracle
+    (etcd_tpu/raft/core.py xorshift32)."""
+    x = x ^ (x << 13)
+    x = x ^ (x >> 17)
+    x = x ^ (x << 5)
+    return x

@@ -1,0 +1,6 @@
+"""Device-mesh parallelism for the batched consensus kernel."""
+
+from etcd_tpu.parallel.mesh import (make_mesh, shard_state, state_sharding,
+                                    mailbox_sharding)
+
+__all__ = ["make_mesh", "shard_state", "state_sharding", "mailbox_sharding"]

@@ -3,7 +3,9 @@ paths compile/execute without TPU hardware (the driver separately dry-runs the
 multi-chip path; see __graft_entry__.py)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of ambient env: the axon TPU backend is tunneled,
+# slow to init, and not what unit tests should exercise.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
