@@ -1,0 +1,4 @@
+from etcd_tpu.snap.snapshotter import (NoSnapshotError, Snapshotter,
+                                       snap_name, parse_snap_name)
+
+__all__ = ["Snapshotter", "NoSnapshotError", "snap_name", "parse_snap_name"]
