@@ -1,0 +1,55 @@
+"""The replicated command (reference etcdserverpb.Request).
+
+Every client mutation becomes one of these, is serialized into a raft entry,
+and is applied deterministically on every member (reference
+etcdserver/server.go:766-820 applyRequest). Encoding is canonical JSON
+(sorted keys, no whitespace) — deterministic and debuggable; the consensus
+hot path never touches these bytes (they ride the host log store).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+METHOD_GET = "GET"
+METHOD_PUT = "PUT"
+METHOD_POST = "POST"
+METHOD_DELETE = "DELETE"
+METHOD_QGET = "QGET"
+METHOD_SYNC = "SYNC"
+
+
+@dataclass(frozen=True)
+class Request:
+    id: int = 0
+    method: str = METHOD_GET
+    path: str = ""
+    val: str = ""
+    dir: bool = False
+    prev_value: str = ""
+    prev_index: int = 0
+    prev_exist: Optional[bool] = None   # tri-state (reference *bool)
+    expiration: Optional[float] = None  # absolute unix seconds; None = keep forever
+    wait: bool = False
+    since: int = 0
+    recursive: bool = False
+    sorted: bool = False
+    quorum: bool = False
+    stream: bool = False
+    time: float = 0.0                   # SYNC: the leader's cutoff timestamp
+    refresh: bool = False               # TTL refresh without value change
+
+    def encode(self) -> bytes:
+        d = {k: v for k, v in asdict(self).items()
+             if v not in (None, "", 0, 0.0, False)}
+        d["id"] = self.id
+        d["method"] = self.method
+        if self.prev_exist is not None:
+            d["prev_exist"] = self.prev_exist
+        return json.dumps(d, sort_keys=True, separators=(",", ":")).encode()
+
+    @staticmethod
+    def decode(data: bytes) -> "Request":
+        d = json.loads(data.decode())
+        return Request(**d)

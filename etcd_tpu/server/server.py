@@ -1,0 +1,511 @@
+"""EtcdServer: the server core wiring consensus to the state machine.
+
+Behavioral equivalent of reference etcdserver/server.go + etcdserver/raft.go:
+bootstrap decision tree (new vs restart), the propose→wait→apply pipeline
+(Do server.go:519-576, apply server.go:729-820), membership ConfChanges with
+validation (server.go:640-662,824-873), snapshot trigger every snap_count
+applies (server.go:476-480,876-916), TTL expiry via replicated SYNC
+(server.go:667-681), and self-attribute publish (server.go:688-715).
+
+Re-designed for the TPU framework: ONE run-loop thread owns the Node and all
+store mutations (the single-writer invariant the reference gets from
+node.run/multiNode.run goroutines), fed by a queue that client threads
+(HTTP handlers) and the transport post into. The Ready drain follows the
+prescribed ordering contract (reference raft/doc.go:28-55): WAL fsync of
+{HardState, Entries} BEFORE transport send, apply committed, then advance.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from etcd_tpu import errors, raftpb
+from etcd_tpu.raftpb import (ConfChange, ConfChangeType, ConfState, Entry,
+                             EntryType, Message, MessageType, Snapshot,
+                             SnapshotMetadata)
+from etcd_tpu.raft.core import Config, ProposalDroppedError
+from etcd_tpu.raft.node import Node, Peer
+from etcd_tpu.raft.storage import CompactedError, MemoryStorage
+from etcd_tpu.server import cluster as cl
+from etcd_tpu.server.cluster import Cluster, Member, STORE_KEYS_PREFIX
+from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
+                                     METHOD_PUT, METHOD_QGET, METHOD_SYNC,
+                                     Request)
+from etcd_tpu.server.storage import ServerStorage, read_wal
+from etcd_tpu.server.transport import Transporter
+from etcd_tpu.snap import Snapshotter
+from etcd_tpu.store import Store
+from etcd_tpu.utils import idutil
+from etcd_tpu.utils.fileutil import touch_dir_all, purge_files
+from etcd_tpu.utils.wait import Wait
+from etcd_tpu.wal import WAL, WalSnapshot, wal_exists
+from etcd_tpu.wal import wal as wal_mod
+
+DEFAULT_SNAP_COUNT = 10000       # reference server.go:56
+CATCH_UP_ENTRIES = 5000          # reference etcdserver/raft.go:38
+MAX_WAL_FILES = 5                # reference -max-wals default
+MAX_SNAP_FILES = 5
+
+_MEMBER_ATTR_SUFFIX = "/attributes"
+
+
+@dataclass
+class ServerConfig:
+    name: str
+    data_dir: str
+    initial_cluster: Dict[str, Sequence[str]] = field(default_factory=dict)
+    cluster_token: str = "etcd-cluster"
+    client_urls: Tuple[str, ...] = ()
+    snap_count: int = DEFAULT_SNAP_COUNT
+    tick_ms: int = 100               # heartbeat interval (reference TickMs)
+    election_ticks: int = 10
+    heartbeat_ticks: int = 1
+    sync_ticks: int = 5              # SYNC every 500ms (reference server.go:300)
+    wal_segment_size: int = wal_mod.SEGMENT_SIZE_BYTES
+    request_timeout: float = 5.0
+    catch_up_entries: int = CATCH_UP_ENTRIES
+
+    @property
+    def waldir(self) -> str:
+        return os.path.join(self.data_dir, "member", "wal")
+
+    @property
+    def snapdir(self) -> str:
+        return os.path.join(self.data_dir, "member", "snap")
+
+
+class EtcdServer:
+    """One consensus member. Drive with start()/stop(); serve client ops via
+    do()/add_member()/remove_member(); feed peer traffic into process()."""
+
+    def __init__(self, cfg: ServerConfig, transport: Transporter,
+                 clock=time.time) -> None:
+        self.cfg = cfg
+        self.clock = clock
+        self.transport = transport
+        self.store = Store(clock=clock)
+        touch_dir_all(cfg.snapdir)
+        self.snapshotter = Snapshotter(cfg.snapdir)
+        self.raft_storage = MemoryStorage()
+        self._applied = 0
+        self._snapi = 0
+        self.wait = Wait()
+        self._inq: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._published = False
+        self._removed_self = False
+        self._sync_elapsed = 0
+        self.lead_elected_ev = threading.Event()
+
+        if wal_exists(cfg.waldir):
+            self._restart()
+        else:
+            self._bootstrap_new()
+        self.reqid = idutil.Generator(self.id & 0xFFFF)
+
+        # Wire known peers into the transport.
+        for m in self.cluster.members():
+            if m.id != self.id:
+                self.transport.add_peer(m.id, m.peer_urls)
+
+    # -- bootstrap ----------------------------------------------------------
+
+    def _bootstrap_new(self) -> None:
+        cfg = self.cfg
+        self.cluster = Cluster.from_initial(self.store, cfg.initial_cluster,
+                                            cfg.cluster_token)
+        me = self.cluster.member_by_name(cfg.name)
+        if me is None:
+            raise ValueError(
+                f"member {cfg.name!r} not in initial cluster "
+                f"{sorted(cfg.initial_cluster)}")
+        if cfg.client_urls:
+            me = Member(me.id, me.name, me.peer_urls, tuple(cfg.client_urls))
+        self.id = me.id
+        metadata = json.dumps({"id": f"{self.id:x}",
+                               "clusterId": f"{self.cluster.cluster_id:x}"}
+                              ).encode()
+        self.wal = WAL.create(cfg.waldir, metadata,
+                              segment_size=cfg.wal_segment_size)
+        self.storage = ServerStorage(self.wal, self.snapshotter)
+        peers = [Peer(id=m.id, context=json.dumps(m.to_dict()).encode())
+                 for m in self.cluster.members()]
+        self.node = Node.start(
+            Config(id=self.id, election_tick=cfg.election_ticks,
+                   heartbeat_tick=cfg.heartbeat_ticks,
+                   storage=self.raft_storage), peers)
+
+    def _restart(self) -> None:
+        cfg = self.cfg
+        snap = self.snapshotter.load_or_none()
+        walsnap = WalSnapshot()
+        if snap is not None:
+            walsnap = WalSnapshot(index=snap.metadata.index,
+                                  term=snap.metadata.term)
+            self.store.recovery(snap.data)
+            self.raft_storage.apply_snapshot(snap)
+            self._applied = snap.metadata.index
+            self._snapi = snap.metadata.index
+        self.cluster = Cluster(self.store, cfg.cluster_token)
+        self.cluster.recover()
+        self.wal, metadata, hs, ents = read_wal(
+            cfg.waldir, walsnap, segment_size=cfg.wal_segment_size)
+        md = json.loads(metadata.decode())
+        self.id = int(md["id"], 16)
+        self.cluster.cluster_id = int(md["clusterId"], 16)
+        self.storage = ServerStorage(self.wal, self.snapshotter)
+        self.raft_storage.set_hard_state(hs)
+        self.raft_storage.append(ents)
+        self.node = Node.restart(
+            Config(id=self.id, election_tick=cfg.election_ticks,
+                   heartbeat_tick=cfg.heartbeat_ticks,
+                   storage=self.raft_storage))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"etcd-{self.cfg.name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        self._inq.put(("noop", None))
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.transport.stop()
+        self.storage.close()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop_ev.is_set()
+
+    # -- client API ---------------------------------------------------------
+
+    def do(self, r: Request) -> Any:
+        """Serve one request (reference Do server.go:519-576): local reads
+        from the store; writes (and quorum reads) through consensus."""
+        if r.method == METHOD_GET:
+            if r.quorum:
+                r = raftpb.replace(r, method=METHOD_QGET)
+            elif r.wait:
+                return self.store.watch(r.path, r.recursive, r.stream, r.since)
+            else:
+                return self.store.get(r.path, r.recursive, r.sorted)
+        if r.method in (METHOD_PUT, METHOD_POST, METHOD_DELETE, METHOD_QGET,
+                        METHOD_SYNC):
+            if r.id == 0:
+                r = raftpb.replace(r, id=self.reqid.next())
+            q = self.wait.register(r.id)
+            self._inq.put(("prop", (r.id, r.encode())))
+            try:
+                result = q.get(timeout=self.cfg.request_timeout)
+            except queue.Empty:
+                self.wait.cancel(r.id)
+                raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
+                                       cause="request timed out",
+                                       index=self.store.current_index)
+            if isinstance(result, errors.EtcdError):
+                raise result
+            return result
+        raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                               cause=f"bad method {r.method}")
+
+    def process(self, m: Message) -> None:
+        """Inbound raft message from the transport (reference
+        server.go:387-404): drop traffic from removed members."""
+        if self.cluster.is_id_removed(m.frm):
+            return
+        self._inq.put(("msg", m))
+
+    def report_unreachable(self, pid: int) -> None:
+        """Transport feedback: peer send failed → leader drops the peer to
+        probe mode (reference server.go:399, raft.go:575-581). Thread-safe."""
+        self._inq.put(("msg", Message(type=MessageType.UNREACHABLE, frm=pid)))
+
+    def report_snapshot(self, pid: int, ok: bool) -> None:
+        """Transport feedback on a snapshot send (reference server.go:403)."""
+        self._inq.put(("msg", Message(type=MessageType.SNAP_STATUS, frm=pid,
+                                      reject=not ok)))
+
+    # -- membership API (reference configure() server.go:640-662) -----------
+
+    def add_member(self, m: Member) -> List[Member]:
+        self.cluster.validate_conf_change("add", m.id, m.peer_urls)
+        cc = ConfChange(id=self.reqid.next(), type=ConfChangeType.ADD_NODE,
+                        node_id=m.id,
+                        context=json.dumps(m.to_dict()).encode())
+        return self._configure(cc)
+
+    def remove_member(self, mid: int) -> List[Member]:
+        self.cluster.validate_conf_change("remove", mid)
+        cc = ConfChange(id=self.reqid.next(),
+                        type=ConfChangeType.REMOVE_NODE, node_id=mid)
+        return self._configure(cc)
+
+    def update_member(self, m: Member) -> List[Member]:
+        self.cluster.validate_conf_change("update", m.id, m.peer_urls)
+        cc = ConfChange(id=self.reqid.next(),
+                        type=ConfChangeType.UPDATE_NODE, node_id=m.id,
+                        context=json.dumps(m.to_dict()).encode())
+        return self._configure(cc)
+
+    def _configure(self, cc: ConfChange) -> List[Member]:
+        q = self.wait.register(cc.id)
+        self._inq.put(("confchange", cc))
+        try:
+            result = q.get(timeout=self.cfg.request_timeout)
+        except queue.Empty:
+            self.wait.cancel(cc.id)
+            raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
+                                   cause="conf change timed out")
+        if isinstance(result, errors.EtcdError):
+            raise result
+        return result
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def leader_id(self) -> int:
+        return self.node.raft.lead
+
+    def is_leader(self) -> bool:
+        return self.leader_id == self.id
+
+    @property
+    def applied_index(self) -> int:
+        return self._applied
+
+    @property
+    def commit_index(self) -> int:
+        return self.node.raft.raft_log.committed
+
+    @property
+    def term(self) -> int:
+        return self.node.raft.term
+
+    # -- run loop -----------------------------------------------------------
+
+    def _run(self) -> None:
+        tick_s = self.cfg.tick_ms / 1000.0
+        next_tick = time.monotonic() + tick_s
+        while not self._stop_ev.is_set():
+            timeout = max(next_tick - time.monotonic(), 0.0)
+            try:
+                kind, payload = self._inq.get(timeout=timeout)
+            except queue.Empty:
+                kind, payload = "tick", None
+            if self._stop_ev.is_set():
+                break
+            if kind == "tick" or time.monotonic() >= next_tick:
+                while time.monotonic() >= next_tick:
+                    self.node.tick()
+                    next_tick += tick_s
+                self._on_tick()
+            if kind == "msg":
+                self.node.step(payload)
+            elif kind == "prop":
+                rid, data = payload
+                try:
+                    self.node.propose(data)
+                except ProposalDroppedError:
+                    self.wait.trigger(rid, errors.EtcdError(
+                        errors.ECODE_LEADER_ELECT, cause="no leader"))
+            elif kind == "confchange":
+                try:
+                    self.node.propose_conf_change(payload)
+                except ProposalDroppedError:
+                    self.wait.trigger(payload.id, errors.EtcdError(
+                        errors.ECODE_LEADER_ELECT, cause="no leader"))
+            self._process_ready()
+            if self._removed_self:
+                self._stop_ev.set()
+
+    def _on_tick(self) -> None:
+        if self.is_leader():
+            self.lead_elected_ev.set()
+            self._sync_elapsed += 1
+            if (self._sync_elapsed >= self.cfg.sync_ticks):
+                self._sync_elapsed = 0
+                if self.store.has_ttl_keys():
+                    r = Request(id=self.reqid.next(), method=METHOD_SYNC,
+                                time=self.clock())
+                    try:
+                        self.node.propose(r.encode())
+                    except ProposalDroppedError:
+                        pass
+        elif self.leader_id != raftpb.NO_LEADER:
+            self.lead_elected_ev.set()
+        if not self._published and self.leader_id != raftpb.NO_LEADER:
+            self._publish()
+
+    def _publish(self) -> None:
+        """Propose our own attributes (reference publish server.go:688-715);
+        retried on later ticks until the apply marks us published."""
+        me = self.cluster.member(self.id)
+        name = self.cfg.name
+        curls = list(self.cfg.client_urls or
+                     (me.client_urls if me else ()))
+        r = Request(id=self.reqid.next(), method=METHOD_PUT,
+                    path=(cl.member_store_key(self.id) + _MEMBER_ATTR_SUFFIX),
+                    val=json.dumps({"name": name, "clientURLs": curls},
+                                   sort_keys=True))
+        try:
+            self.node.propose(r.encode())
+        except ProposalDroppedError:
+            pass
+
+    def _process_ready(self) -> None:
+        while True:
+            rd = self.node.ready()
+            if rd is None:
+                return
+            # 1. Persist: snapshot file, then WAL {HardState, Entries} fsync
+            #    (reference etcdserver/raft.go:139-160, contract doc.go:31-39).
+            if not rd.snapshot.is_empty():
+                self.storage.save_snap(rd.snapshot)
+            self.storage.save(rd.hard_state, list(rd.entries))
+            if not rd.snapshot.is_empty():
+                self.raft_storage.apply_snapshot(rd.snapshot)
+                self._recover_from_snapshot(rd.snapshot)
+            if rd.entries:
+                self.raft_storage.append(list(rd.entries))
+            # 2. Send AFTER persist.
+            self.transport.send(rd.messages)
+            # 3. Apply committed entries, then acknowledge.
+            self._apply_entries(rd.committed_entries)
+            self.node.advance()
+            self._maybe_snapshot()
+
+    def _recover_from_snapshot(self, snap: Snapshot) -> None:
+        """A MsgSnap overtook our log: reset the state machine from the
+        leader's snapshot (reference server.go:429-453)."""
+        self.store.recovery(snap.data)
+        self.cluster.recover()
+        self._applied = snap.metadata.index
+        self._snapi = snap.metadata.index
+        for m in self.cluster.members():
+            if m.id != self.id:
+                self.transport.add_peer(m.id, m.peer_urls)
+
+    def _apply_entries(self, ents: Sequence[Entry]) -> None:
+        for e in ents:
+            if e.index <= self._applied:
+                continue
+            if e.type == EntryType.NORMAL:
+                self._apply_normal(e)
+            elif e.type == EntryType.CONF_CHANGE:
+                self._apply_conf_change(e)
+            self._applied = e.index
+
+    def _apply_normal(self, e: Entry) -> None:
+        if not e.data:
+            return  # leader's empty commit marker
+        r = Request.decode(e.data)
+        try:
+            result = self._apply_request(r)
+        except errors.EtcdError as err:
+            result = err
+        self.wait.trigger(r.id, result)
+
+    def _apply_request(self, r: Request):
+        """Deterministic request→store mapping (reference applyRequest
+        server.go:766-820)."""
+        st = self.store
+        exp = r.expiration
+        if r.method == METHOD_POST:
+            return st.create(r.path, is_dir=r.dir, value=r.val, unique=True,
+                             expire_time=exp)
+        if r.method == METHOD_PUT:
+            if r.prev_exist is not None:
+                if r.prev_exist:
+                    if r.prev_index or r.prev_value:
+                        return st.compare_and_swap(r.path, r.prev_value,
+                                                   r.prev_index, r.val, exp)
+                    return st.update(r.path, r.val, exp,
+                                     keep_ttl=r.refresh)
+                return st.create(r.path, is_dir=r.dir, value=r.val,
+                                 expire_time=exp)
+            if r.prev_index or r.prev_value:
+                return st.compare_and_swap(r.path, r.prev_value,
+                                           r.prev_index, r.val, exp)
+            # Publish path: keep the cluster view in sync (reference
+            # storeMemberAttributeRegexp special case).
+            if (r.path.startswith(cl.STORE_CLUSTER_PREFIX) and
+                    r.path.endswith(_MEMBER_ATTR_SUFFIX)):
+                mid = int(r.path.rsplit("/", 2)[1], 16)
+                d = json.loads(r.val)
+                self.cluster.update_member_attributes(
+                    mid, d.get("name", ""), d.get("clientURLs", ()))
+                if mid == self.id:
+                    self._published = True
+            return st.set(r.path, is_dir=r.dir, value=r.val, expire_time=exp)
+        if r.method == METHOD_DELETE:
+            if r.prev_index or r.prev_value:
+                return st.compare_and_delete(r.path, r.prev_value,
+                                             r.prev_index)
+            return st.delete(r.path, is_dir=r.dir, recursive=r.recursive)
+        if r.method == METHOD_QGET:
+            return st.get(r.path, r.recursive, r.sorted)
+        if r.method == METHOD_SYNC:
+            st.delete_expired_keys(r.time)
+            return None
+        raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                               cause=f"bad method {r.method}")
+
+    def _apply_conf_change(self, e: Entry) -> None:
+        cc = raftpb.decode_conf_change(e.data)
+        cs = self.node.apply_conf_change(cc)
+        if cc.type == ConfChangeType.ADD_NODE:
+            if cc.context:
+                d = json.loads(cc.context.decode())
+                m = Member(id=int(d["id"], 16) if isinstance(d["id"], str)
+                           else d["id"],
+                           name=d.get("name", ""),
+                           peer_urls=tuple(d.get("peerURLs", ())),
+                           client_urls=tuple(d.get("clientURLs", ())))
+            else:
+                m = Member(id=cc.node_id)
+            self.cluster.add_member(m)
+            if m.id != self.id:
+                self.transport.add_peer(m.id, m.peer_urls)
+        elif cc.type == ConfChangeType.REMOVE_NODE:
+            self.cluster.remove_member(cc.node_id)
+            if cc.node_id == self.id:
+                self._removed_self = True
+            else:
+                self.transport.remove_peer(cc.node_id)
+        elif cc.type == ConfChangeType.UPDATE_NODE:
+            if cc.context:
+                d = json.loads(cc.context.decode())
+                self.cluster.update_member_raft_attributes(
+                    cc.node_id, tuple(d.get("peerURLs", ())))
+                if cc.node_id != self.id:
+                    self.transport.update_peer(cc.node_id,
+                                               d.get("peerURLs", ()))
+        self.wait.trigger(cc.id, self.cluster.members())
+
+    def _maybe_snapshot(self) -> None:
+        """Snapshot + compact once enough entries applied (reference
+        server.go:476-480,876-916)."""
+        if self._applied - self._snapi <= self.cfg.snap_count:
+            return
+        data = self.store.save()
+        cs = ConfState(nodes=tuple(self.node.raft.nodes()))
+        snap = self.raft_storage.create_snapshot(self._applied, cs, data)
+        self.storage.save_snap(snap)
+        self._snapi = self._applied
+        compacti = self._snapi - self.cfg.catch_up_entries
+        if compacti > self.raft_storage.first_index():
+            try:
+                self.raft_storage.compact(compacti)
+            except CompactedError:
+                pass
+        purge_files(self.cfg.waldir, ".wal", MAX_WAL_FILES)
+        purge_files(self.cfg.snapdir, ".snap", MAX_SNAP_FILES)

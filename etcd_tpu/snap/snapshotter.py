@@ -53,6 +53,7 @@ class Snapshotter:
         crc = zlib.crc32(body)
         tmp = os.path.join(self.dir, name + ".tmp")
         with open(tmp, "wb") as f:
+            os.fchmod(f.fileno(), fileutil.PRIVATE_FILE_MODE)
             f.write(_ENVELOPE.pack(crc, len(body)))
             f.write(body)
             f.flush()

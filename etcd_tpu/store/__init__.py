@@ -1,0 +1,9 @@
+from etcd_tpu.store.event import (Event, EventHistory, NodeExtern, GET, CREATE,
+                                  SET, UPDATE, DELETE, COMPARE_AND_SWAP,
+                                  COMPARE_AND_DELETE, EXPIRE)
+from etcd_tpu.store.store import Store
+from etcd_tpu.store.watcher import Watcher, WatcherHub
+
+__all__ = ["Store", "Event", "EventHistory", "NodeExtern", "Watcher",
+           "WatcherHub", "GET", "CREATE", "SET", "UPDATE", "DELETE",
+           "COMPARE_AND_SWAP", "COMPARE_AND_DELETE", "EXPIRE"]

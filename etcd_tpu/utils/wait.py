@@ -1,0 +1,42 @@
+"""The propose→apply rendezvous registry (reference pkg/wait/wait.go:21-58).
+
+A proposer registers a request id and blocks on the returned queue; the apply
+loop triggers the id with the result once the entry commits and applies.
+Thread-safe: proposers are HTTP handler threads, the trigger side is the
+single run-loop thread.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+
+class Wait:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._waiters: Dict[int, "queue.Queue[Any]"] = {}
+
+    def register(self, wid: int) -> "queue.Queue[Any]":
+        with self._lock:
+            if wid in self._waiters:
+                raise ValueError(f"duplicate wait id {wid:x}")
+            q: "queue.Queue[Any]" = queue.Queue(maxsize=1)
+            self._waiters[wid] = q
+            return q
+
+    def trigger(self, wid: int, value: Any) -> bool:
+        with self._lock:
+            q = self._waiters.pop(wid, None)
+        if q is None:
+            return False
+        q.put(value)
+        return True
+
+    def is_registered(self, wid: int) -> bool:
+        with self._lock:
+            return wid in self._waiters
+
+    def cancel(self, wid: int) -> None:
+        with self._lock:
+            self._waiters.pop(wid, None)

@@ -99,9 +99,18 @@ def wal_exists(dirname: str) -> bool:
 
 
 def _scan_names(dirname: str) -> List[str]:
-    """Valid .wal names in the dir, sorted; verifies the seq chain is
-    contiguous (reference wal.go searchIndex/isValidSeq)."""
-    names = [n for n in fileutil.read_dir(dirname) if n.endswith(".wal")]
+    """Valid .wal names in the dir, sorted; skips unparseable strays
+    (reference readWALNames) and verifies the seq chain is contiguous
+    (reference wal.go searchIndex/isValidSeq)."""
+    names = []
+    for n in fileutil.read_dir(dirname):
+        if not n.endswith(".wal"):
+            continue
+        try:
+            parse_wal_name(n)
+        except ValueError:
+            continue  # stray file (editor backup etc.) — ignore
+        names.append(n)
     last_seq = None
     for n in names:
         seq, _ = parse_wal_name(n)
@@ -245,6 +254,7 @@ class WAL:
         fileutil.create_dir_all(tmp)
         name = wal_name(0, 0)
         f = open(os.path.join(tmp, name), "wb")
+        os.fchmod(f.fileno(), fileutil.PRIVATE_FILE_MODE)
         w = WAL(dirname, metadata, segment_size)
         w._tail = f
         w._enc = _Encoder(f, 0)
@@ -254,6 +264,7 @@ class WAL:
         w._names = [name]
         f.flush()
         fileutil.fsync(f.fileno())
+        fileutil.fsync_dir(tmp)  # make the segment's dir entry durable
         os.rename(tmp, dirname)
         fileutil.fsync_dir(os.path.dirname(dirname.rstrip("/")) or ".")
         # Reopen at the final path and take the lock.
@@ -384,12 +395,17 @@ class WAL:
                                "first on an opened WAL)")
 
     def save(self, st: HardState, ents: List[Entry]) -> None:
-        """Append entries + state; fsync if anything durable changed
-        (reference wal.go:459-487 Save + mustSync)."""
+        """Append entries + state; fsync only when durability demands it —
+        entries appended or term/vote changed. A commit-only HardState
+        advance is recorded but NOT synced, since commit is recoverable
+        (reference wal.go:459-487 Save + raft MustSync rule)."""
         self._ensure_writable()
         state_changed = not st.is_empty() and st != self.state
         if not ents and not state_changed:
             return
+        must_sync = bool(ents) or (not st.is_empty() and
+                                   (st.term != self.state.term or
+                                    st.vote != self.state.vote))
         for e in ents:
             self._enc.encode(ENTRY_TYPE, raftpb.encode_entry(e))
             self.enti = e.index
@@ -397,8 +413,9 @@ class WAL:
             self._enc.encode(STATE_TYPE, raftpb.encode_hard_state(st))
             self.state = st
         self._enc.flush()
-        fileutil.fsync(self._tail.fileno())
-        self.fsync_count += 1
+        if must_sync:
+            fileutil.fsync(self._tail.fileno())
+            self.fsync_count += 1
         if self._tail.tell() >= self.segment_size:
             self._cut()
 
@@ -428,6 +445,7 @@ class WAL:
         name = wal_name(seq + 1, self.enti + 1)
         path = os.path.join(self.dir, name)
         f = open(path, "w+b")
+        os.fchmod(f.fileno(), fileutil.PRIVATE_FILE_MODE)
         prev_crc = self._enc.crc
         self._tail.close()
         self._tail = f

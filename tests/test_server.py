@@ -1,0 +1,353 @@
+"""Server-core tests: an in-process multi-member cluster over the in-memory
+transport, modeled on reference etcdserver/server_test.go scenarios plus a
+miniature of the integration tier (§4 T4): propose/apply, TTL sync expiry,
+membership changes, restart from WAL, snapshot trigger + catch-up.
+"""
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from etcd_tpu import errors
+from etcd_tpu.server import EtcdServer, Member, Request, ServerConfig
+from etcd_tpu.server.cluster import STORE_KEYS_PREFIX
+from etcd_tpu.server.transport import InMemoryNetwork, InMemoryTransport
+from etcd_tpu.server.request import METHOD_DELETE, METHOD_GET, METHOD_PUT
+
+
+class ClusterFixture:
+    """Boots N EtcdServers wired by one InMemoryNetwork (the moral of
+    reference integration/cluster_test.go mustNewMember/Launch)."""
+
+    def __init__(self, tmpdir, n=3, tick_ms=10, snap_count=10000,
+                 catch_up=5):
+        self.tmpdir = str(tmpdir)
+        self.net = InMemoryNetwork()
+        self.tick_ms = tick_ms
+        self.snap_count = snap_count
+        self.catch_up = catch_up
+        self.initial = {f"m{i}": [f"mem://{i}"] for i in range(n)}
+        self.servers = {}
+        for name in self.initial:
+            self.launch(name)
+
+    def config(self, name):
+        return ServerConfig(
+            name=name,
+            data_dir=os.path.join(self.tmpdir, name),
+            initial_cluster=self.initial,
+            client_urls=(f"http://127.0.0.1/{name}",),
+            tick_ms=self.tick_ms,
+            snap_count=self.snap_count,
+            catch_up_entries=self.catch_up,
+            request_timeout=5.0,
+        )
+
+    def launch(self, name, cfg=None):
+        cfg = cfg or self.config(name)
+        # Transport needs the member id, which the server computes; build the
+        # server first with a placeholder then register.
+        tr = InMemoryTransport(self.net, 0)
+        srv = EtcdServer(cfg, tr)
+        tr.id = srv.id
+        tr.report_unreachable = srv.report_unreachable
+        tr.report_snapshot = srv.report_snapshot
+        self.net.register(srv.id, _InboxAdapter(srv))
+        self.servers[name] = srv
+        srv.start()
+        return srv
+
+    def wait_leader(self, timeout=10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for s in self.servers.values():
+                if s.is_leader():
+                    return s
+            time.sleep(0.01)
+        raise AssertionError("no leader elected")
+
+    def leader(self):
+        return self.wait_leader()
+
+    def follower(self):
+        lead = self.wait_leader()
+        for s in self.servers.values():
+            if s is not lead:
+                return s
+        raise AssertionError("no follower")
+
+    def stop_all(self):
+        for s in self.servers.values():
+            if not s.stopped:
+                s.stop()
+
+
+class _InboxAdapter:
+    def __init__(self, srv):
+        self.srv = srv
+
+    def put_nowait(self, m):
+        self.srv.process(m)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = ClusterFixture(tmp_path)
+    yield c
+    c.stop_all()
+
+
+def put(srv, path, val, **kw):
+    return srv.do(Request(method=METHOD_PUT, path=STORE_KEYS_PREFIX + path,
+                          val=val, **kw))
+
+
+def get(srv, path, **kw):
+    return srv.do(Request(method=METHOD_GET, path=STORE_KEYS_PREFIX + path,
+                          **kw))
+
+
+class TestClusterBasics:
+    def test_leader_elected(self, cluster):
+        lead = cluster.wait_leader()
+        assert lead.is_leader()
+
+    def test_put_get_roundtrip(self, cluster):
+        lead = cluster.leader()
+        e = put(lead, "/foo", "bar")
+        assert e.action == "set" and e.node.value == "bar"
+        got = get(lead, "/foo")
+        assert got.node.value == "bar"
+
+    def test_write_replicates_to_all(self, cluster):
+        lead = cluster.leader()
+        put(lead, "/r", "v")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                if all(get(s, "/r").node.value == "v"
+                       for s in cluster.servers.values()):
+                    return
+            except errors.EtcdError:
+                pass
+            time.sleep(0.02)
+        raise AssertionError("replication did not converge")
+
+    def test_follower_write_forwarded(self, cluster):
+        fol = cluster.follower()
+        e = put(fol, "/fwd", "yes")
+        assert e.node.value == "yes"
+        assert get(fol, "/fwd", quorum=True).node.value == "yes"
+
+    def test_quorum_get(self, cluster):
+        lead = cluster.leader()
+        put(lead, "/q", "1")
+        e = get(cluster.follower(), "/q", quorum=True)
+        assert e.node.value == "1"
+
+    def test_cas_through_consensus(self, cluster):
+        lead = cluster.leader()
+        put(lead, "/c", "a")
+        e = put(lead, "/c", "b", prev_value="a")
+        assert e.action == "compareAndSwap"
+        with pytest.raises(errors.EtcdError) as ei:
+            put(lead, "/c", "x", prev_value="nope")
+        assert ei.value.code == errors.ECODE_TEST_FAILED
+
+    def test_delete(self, cluster):
+        lead = cluster.leader()
+        put(lead, "/d", "v")
+        lead.do(Request(method=METHOD_DELETE, path=STORE_KEYS_PREFIX + "/d"))
+        with pytest.raises(errors.EtcdError):
+            get(lead, "/d")
+
+    def test_publish_attributes(self, cluster):
+        lead = cluster.leader()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            ms = {m.name for m in lead.cluster.members() if m.name}
+            if ms == set(cluster.initial):
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"publish incomplete: {ms}")
+
+
+class TestTTL:
+    def test_sync_expires_keys_cluster_wide(self, cluster):
+        lead = cluster.leader()
+        put(lead, "/ttl", "v", expiration=time.time() + 0.3)
+        assert get(lead, "/ttl").node.value == "v"
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                get(lead, "/ttl")
+                time.sleep(0.05)
+            except errors.EtcdError as e:
+                assert e.code == errors.ECODE_KEY_NOT_FOUND
+                break
+        else:
+            raise AssertionError("TTL key never expired")
+        # Expiry must be replicated (applied on followers too).
+        deadline = time.time() + 5
+        fol = cluster.follower()
+        while time.time() < deadline:
+            try:
+                get(fol, "/ttl")
+                time.sleep(0.05)
+            except errors.EtcdError:
+                return
+        raise AssertionError("expiry did not replicate")
+
+
+class TestMembership:
+    def test_add_member(self, cluster):
+        lead = cluster.leader()
+        newm = Member.new("m3", ["mem://3"], cluster_token="etcd-cluster")
+        members = lead.add_member(newm)
+        assert newm.id in {m.id for m in members}
+        assert len(members) == 4
+
+    def test_remove_member_rejoin_blocked(self, cluster):
+        lead = cluster.leader()
+        victim = cluster.follower()
+        lead.remove_member(victim.id)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if victim.stopped:
+                break
+            time.sleep(0.02)
+        assert victim.stopped, "removed member should stop itself"
+        assert lead.cluster.is_id_removed(victim.id)
+        # Cluster still serves with 2/3.
+        e = put(lead, "/after-removal", "ok")
+        assert e.node.value == "ok"
+
+    def test_add_duplicate_member_rejected(self, cluster):
+        lead = cluster.leader()
+        existing = next(iter(cluster.servers.values()))
+        m = lead.cluster.member(existing.id)
+        with pytest.raises(errors.EtcdError):
+            lead.add_member(m)
+
+
+class TestRestart:
+    def test_restart_replays_wal(self, tmp_path):
+        c = ClusterFixture(tmp_path)
+        try:
+            lead = c.leader()
+            for i in range(5):
+                put(lead, f"/k{i}", f"v{i}")
+            # Stop a follower cleanly, then relaunch from its data dir.
+            fol = c.follower()
+            name = fol.cfg.name
+            fol.stop()
+            c.net.unregister(fol.id)
+            srv = c.launch(name)
+            assert srv.id == fol.id, "member id must survive restart"
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    if all(get(srv, f"/k{i}").node.value == f"v{i}"
+                           for i in range(5)):
+                        break
+                except errors.EtcdError:
+                    pass
+                time.sleep(0.05)
+            else:
+                raise AssertionError("restarted member did not recover state")
+            # And it still participates: new writes reach it.
+            put(c.leader(), "/post-restart", "yes")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                try:
+                    if get(srv, "/post-restart").node.value == "yes":
+                        break
+                except errors.EtcdError:
+                    pass
+                time.sleep(0.05)
+            else:
+                raise AssertionError("restarted member not participating")
+        finally:
+            c.stop_all()
+
+
+class TestSnapshot:
+    def test_snapshot_trigger_and_compaction(self, tmp_path):
+        c = ClusterFixture(tmp_path, snap_count=20)
+        try:
+            lead = c.leader()
+            for i in range(30):
+                put(lead, "/snapkey", f"v{i}")
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if lead._snapi > 0:
+                    break
+                time.sleep(0.05)
+            assert lead._snapi > 0, "snapshot never triggered"
+            snapdir = lead.cfg.snapdir
+            assert any(n.endswith(".snap") for n in os.listdir(snapdir))
+            # Log got compacted behind the snapshot.
+            assert lead.raft_storage.first_index() > 1
+        finally:
+            c.stop_all()
+
+    def test_lagging_follower_caught_up_via_msgsnap(self, tmp_path):
+        # Follower misses enough entries that the leader's log is compacted
+        # past its position: catch-up must go through a snapshot install
+        # (reference raft.go:246-260 sendAppend→MsgSnap, server.go:429-453).
+        c = ClusterFixture(tmp_path, snap_count=10, catch_up=2)
+        try:
+            lead = c.leader()
+            fol = c.follower()
+            c.net.isolate(fol.id)
+            for i in range(40):
+                put(lead, "/lag", f"v{i}")
+            deadline = time.time() + 10
+            while time.time() < deadline and lead._snapi == 0:
+                time.sleep(0.05)
+            assert lead.raft_storage.first_index() > 1, "log not compacted"
+            c.net.unisolate(fol.id)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                try:
+                    if get(fol, "/lag").node.value == "v39":
+                        break
+                except errors.EtcdError:
+                    pass
+                time.sleep(0.05)
+            else:
+                raise AssertionError("follower never caught up via snapshot")
+            # Its store was rebuilt from the snapshot (applied index jumped).
+            assert fol._snapi > 0 or fol.applied_index >= lead._snapi
+        finally:
+            c.stop_all()
+
+    def test_restart_from_snapshot(self, tmp_path):
+        c = ClusterFixture(tmp_path, snap_count=20)
+        try:
+            lead = c.leader()
+            for i in range(30):
+                put(lead, "/sk", f"v{i}")
+            fol = c.follower()
+            # Wait until the follower snapshotted too.
+            deadline = time.time() + 10
+            while time.time() < deadline and fol._snapi == 0:
+                time.sleep(0.05)
+            assert fol._snapi > 0
+            name = fol.cfg.name
+            fol.stop()
+            c.net.unregister(fol.id)
+            srv = c.launch(name)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    if get(srv, "/sk").node.value == "v29":
+                        return
+                except errors.EtcdError:
+                    pass
+                time.sleep(0.05)
+            raise AssertionError("snapshot restart did not recover")
+        finally:
+            c.stop_all()

@@ -1,0 +1,442 @@
+"""v2 store tests, modeled on reference store/store_test.go,
+store/event_test.go scenarios: CRUD matrix, CAS/CAD, TTL expiry, hidden
+keys, in-order keys, watch semantics incl. history scan, save/recovery/clone.
+"""
+import json
+
+import pytest
+
+from etcd_tpu import errors
+from etcd_tpu.store import (COMPARE_AND_DELETE, COMPARE_AND_SWAP, CREATE,
+                            DELETE, EXPIRE, GET, SET, UPDATE, Store)
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return Clock()
+
+
+@pytest.fixture
+def s(clock):
+    return Store(clock=clock)
+
+
+class TestCreateGet:
+    def test_create_file(self, s):
+        e = s.create("/foo", value="bar")
+        assert e.action == CREATE
+        assert e.node.key == "/foo" and e.node.value == "bar"
+        assert e.node.created_index == 1 and e.node.modified_index == 1
+        assert s.current_index == 1
+
+    def test_create_existing_fails(self, s):
+        s.create("/foo", value="bar")
+        with pytest.raises(errors.EtcdError) as ei:
+            s.create("/foo", value="baz")
+        assert ei.value.code == errors.ECODE_NODE_EXIST
+
+    def test_create_intermediate_dirs(self, s):
+        s.create("/a/b/c", value="x")
+        e = s.get("/a", recursive=True)
+        assert e.node.dir
+        assert e.node.nodes[0].key == "/a/b"
+        assert e.node.nodes[0].nodes[0].value == "x"
+
+    def test_create_under_file_fails(self, s):
+        s.create("/f", value="1")
+        with pytest.raises(errors.EtcdError) as ei:
+            s.create("/f/child", value="2")
+        assert ei.value.code == errors.ECODE_NOT_DIR
+
+    def test_create_dir(self, s):
+        e = s.create("/d", is_dir=True)
+        assert e.node.dir and e.node.value is None
+        got = s.get("/d")
+        assert got.node.dir and got.node.nodes == []
+
+    def test_get_missing(self, s):
+        with pytest.raises(errors.EtcdError) as ei:
+            s.get("/nope")
+        assert ei.value.code == errors.ECODE_KEY_NOT_FOUND
+        assert ei.value.status_code == 404
+
+    def test_get_sorted(self, s):
+        for k in ["/d/z", "/d/a", "/d/m"]:
+            s.create(k, value="v")
+        e = s.get("/d", want_sorted=True)
+        assert [n.key for n in e.node.nodes] == ["/d/a", "/d/m", "/d/z"]
+
+    def test_get_non_recursive_hides_grandchildren(self, s):
+        s.create("/d/sub/leaf", value="v")
+        e = s.get("/d")
+        assert e.node.nodes[0].dir
+        assert e.node.nodes[0].nodes is None
+
+    def test_root_get(self, s):
+        s.create("/x", value="1")
+        e = s.get("/")
+        assert e.node.dir
+        assert [n.key for n in e.node.nodes] == ["/x"]
+
+    def test_create_root_fails(self, s):
+        with pytest.raises(errors.EtcdError) as ei:
+            s.set("/", value="v")
+        assert ei.value.code == errors.ECODE_ROOT_RONLY
+
+
+class TestInOrder:
+    def test_unique_keys_ordered(self, s):
+        e1 = s.create("/q", value="a", unique=True)
+        e2 = s.create("/q", value="b", unique=True)
+        assert e1.node.key < e2.node.key
+        assert e1.node.key == f"/q/{1:020d}"
+        got = s.get("/q", want_sorted=True)
+        assert [n.value for n in got.node.nodes] == ["a", "b"]
+
+
+class TestSetUpdate:
+    def test_set_replaces_and_reports_prev(self, s):
+        s.create("/foo", value="old")
+        e = s.set("/foo", value="new")
+        assert e.action == SET
+        assert e.prev_node.value == "old"
+        assert e.node.value == "new"
+        assert e.node.created_index == 2  # set creates anew
+
+    def test_set_fresh_has_no_prev(self, s):
+        e = s.set("/fresh", value="v")
+        assert e.prev_node is None
+
+    def test_set_over_dir_fails(self, s):
+        s.create("/d", is_dir=True)
+        with pytest.raises(errors.EtcdError) as ei:
+            s.set("/d", value="v")
+        assert ei.value.code == errors.ECODE_NOT_FILE
+
+    def test_update_keeps_created_index(self, s):
+        s.create("/foo", value="a")
+        e = s.update("/foo", value="b")
+        assert e.action == UPDATE
+        assert e.node.created_index == 1
+        assert e.node.modified_index == 2
+        assert e.prev_node.value == "a"
+
+    def test_update_missing_fails(self, s):
+        with pytest.raises(errors.EtcdError) as ei:
+            s.update("/nope", value="v")
+        assert ei.value.code == errors.ECODE_KEY_NOT_FOUND
+
+    def test_update_dir_with_value_fails(self, s):
+        s.create("/d", is_dir=True)
+        with pytest.raises(errors.EtcdError) as ei:
+            s.update("/d", value="v")
+        assert ei.value.code == errors.ECODE_NOT_FILE
+
+    def test_update_dir_ttl(self, s, clock):
+        s.create("/d", is_dir=True)
+        e = s.update("/d", expire_time=clock.t + 60)
+        assert e.node.ttl == 60
+
+
+class TestCompareAndSwap:
+    def test_cas_by_value(self, s):
+        s.create("/k", value="one")
+        e = s.compare_and_swap("/k", "one", 0, "two")
+        assert e.action == COMPARE_AND_SWAP
+        assert e.node.value == "two" and e.prev_node.value == "one"
+
+    def test_cas_by_index(self, s):
+        s.create("/k", value="one")
+        e = s.compare_and_swap("/k", "", 1, "two")
+        assert e.node.value == "two"
+
+    def test_cas_wrong_value(self, s):
+        s.create("/k", value="one")
+        with pytest.raises(errors.EtcdError) as ei:
+            s.compare_and_swap("/k", "nope", 0, "two")
+        assert ei.value.code == errors.ECODE_TEST_FAILED
+        assert s.get("/k").node.value == "one"
+
+    def test_cas_wrong_index(self, s):
+        s.create("/k", value="one")
+        with pytest.raises(errors.EtcdError) as ei:
+            s.compare_and_swap("/k", "", 99, "two")
+        assert ei.value.code == errors.ECODE_TEST_FAILED
+
+    def test_cas_on_dir_fails(self, s):
+        s.create("/d", is_dir=True)
+        with pytest.raises(errors.EtcdError) as ei:
+            s.compare_and_swap("/d", "x", 0, "y")
+        assert ei.value.code == errors.ECODE_NOT_FILE
+
+    def test_cas_both_conditions(self, s):
+        s.create("/k", value="one")
+        with pytest.raises(errors.EtcdError):
+            s.compare_and_swap("/k", "one", 99, "two")  # index wrong
+        e = s.compare_and_swap("/k", "one", 1, "two")
+        assert e.node.value == "two"
+
+
+class TestDelete:
+    def test_delete_file(self, s):
+        s.create("/f", value="v")
+        e = s.delete("/f")
+        assert e.action == DELETE
+        assert e.prev_node.value == "v"
+        assert e.node.value is None
+        with pytest.raises(errors.EtcdError):
+            s.get("/f")
+
+    def test_delete_dir_requires_flag(self, s):
+        s.create("/d", is_dir=True)
+        with pytest.raises(errors.EtcdError) as ei:
+            s.delete("/d")
+        assert ei.value.code == errors.ECODE_NOT_FILE
+        e = s.delete("/d", is_dir=True)
+        assert e.action == DELETE
+
+    def test_delete_nonempty_dir_requires_recursive(self, s):
+        s.create("/d/kid", value="v")
+        with pytest.raises(errors.EtcdError) as ei:
+            s.delete("/d", is_dir=True)
+        assert ei.value.code == errors.ECODE_DIR_NOT_EMPTY
+        assert ei.value.status_code == 403
+        s.delete("/d", recursive=True)  # recursive implies dir
+        with pytest.raises(errors.EtcdError):
+            s.get("/d")
+
+    def test_delete_root_fails(self, s):
+        with pytest.raises(errors.EtcdError) as ei:
+            s.delete("/", recursive=True)
+        assert ei.value.code == errors.ECODE_ROOT_RONLY
+
+    def test_cad(self, s):
+        s.create("/k", value="one")
+        with pytest.raises(errors.EtcdError) as ei:
+            s.compare_and_delete("/k", "wrong", 0)
+        assert ei.value.code == errors.ECODE_TEST_FAILED
+        e = s.compare_and_delete("/k", "one", 0)
+        assert e.action == COMPARE_AND_DELETE
+        with pytest.raises(errors.EtcdError):
+            s.get("/k")
+
+
+class TestTTL:
+    def test_ttl_reported(self, s, clock):
+        s.create("/t", value="v", expire_time=clock.t + 100)
+        e = s.get("/t")
+        assert e.node.ttl == 100
+        assert e.node.expiration == clock.t + 100
+
+    def test_expiry_via_sync(self, s, clock):
+        s.create("/t1", value="v", expire_time=clock.t + 10)
+        s.create("/t2", value="v", expire_time=clock.t + 20)
+        s.create("/keep", value="v")
+        clock.t += 15
+        evs = s.delete_expired_keys(clock.t)
+        assert [e.node.key for e in evs] == ["/t1"]
+        assert evs[0].action == EXPIRE
+        assert evs[0].prev_node.value == "v"
+        with pytest.raises(errors.EtcdError):
+            s.get("/t1")
+        s.get("/t2"), s.get("/keep")
+        clock.t += 10
+        evs = s.delete_expired_keys(clock.t)
+        assert [e.node.key for e in evs] == ["/t2"]
+
+    def test_update_ttl_reschedules(self, s, clock):
+        s.create("/t", value="v", expire_time=clock.t + 10)
+        s.update("/t", value="v", expire_time=clock.t + 1000)
+        clock.t += 500
+        assert s.delete_expired_keys(clock.t) == []
+        assert s.get("/t").node.value == "v"
+
+    def test_update_to_permanent(self, s, clock):
+        s.create("/t", value="v", expire_time=clock.t + 10)
+        s.update("/t", value="v", expire_time=None)
+        clock.t += 100
+        assert s.delete_expired_keys(clock.t) == []
+        assert s.get("/t").node.expiration is None
+
+    def test_expiring_dir_removes_subtree(self, s, clock):
+        s.create("/d", is_dir=True, expire_time=clock.t + 5)
+        s.create("/d/kid", value="v")
+        clock.t += 10
+        evs = s.delete_expired_keys(clock.t)
+        assert [e.node.key for e in evs] == ["/d"]
+        with pytest.raises(errors.EtcdError):
+            s.get("/d/kid")
+
+
+class TestHidden:
+    def test_hidden_excluded_from_listing(self, s):
+        s.create("/d/_secret", value="s")
+        s.create("/d/plain", value="p")
+        e = s.get("/d")
+        assert [n.key for n in e.node.nodes] == ["/d/plain"]
+
+    def test_hidden_directly_addressable(self, s):
+        s.create("/d/_secret", value="s")
+        assert s.get("/d/_secret").node.value == "s"
+
+    def test_hidden_not_notified_to_recursive_watcher(self, s):
+        w = s.watch("/d", recursive=True)
+        s.create("/d/_secret", value="s")
+        s.create("/d/plain", value="p")
+        e = w.next_event(timeout=1)
+        assert e.node.key == "/d/plain"
+
+    def test_exact_watch_on_hidden_fires(self, s):
+        w = s.watch("/d/_secret")
+        s.create("/d/_secret", value="s")
+        e = w.next_event(timeout=1)
+        assert e.node.key == "/d/_secret"
+
+
+class TestWatch:
+    def test_exact_watch(self, s):
+        w = s.watch("/k")
+        s.create("/other", value="x")
+        s.create("/k", value="v")
+        e = w.next_event(timeout=1)
+        assert e.action == CREATE and e.node.key == "/k"
+
+    def test_recursive_watch(self, s):
+        w = s.watch("/d", recursive=True)
+        s.create("/d/a/b", value="v")
+        e = w.next_event(timeout=1)
+        assert e.node.key == "/d/a/b"
+
+    def test_nonrecursive_watch_ignores_children(self, s):
+        w = s.watch("/d")
+        s.create("/d/kid", value="v")
+        s.create("/d2", value="x")
+        # Only a direct event on /d fires; creating /d/kid implicitly makes
+        # /d but emits the event for /d/kid — so nothing is delivered.
+        s.delete("/d", recursive=True)  # event ON /d fires exact watcher
+        e = w.next_event(timeout=1)
+        assert e.action == DELETE and e.node.key == "/d"
+
+    def test_oneshot_watch_removed_after_fire(self, s):
+        w = s.watch("/k")
+        assert s.watcher_hub.count == 1
+        s.create("/k", value="v")
+        w.next_event(timeout=1)
+        assert s.watcher_hub.count == 0
+
+    def test_stream_watch_stays(self, s):
+        w = s.watch("/k", stream=True)
+        s.create("/k", value="1")
+        s.set("/k", value="2")
+        assert w.next_event(timeout=1).node.value == "1"
+        assert w.next_event(timeout=1).node.value == "2"
+        assert s.watcher_hub.count == 1
+        w.remove()
+        assert s.watcher_hub.count == 0
+
+    def test_since_index_replays_history(self, s):
+        s.create("/k", value="1")   # index 1
+        s.set("/k", value="2")      # index 2
+        s.set("/k", value="3")      # index 3
+        w = s.watch("/k", since_index=2)
+        e = w.next_event(timeout=1)
+        assert e.node.value == "2" and e.index == 2
+
+    def test_since_future_index_blocks_until_event(self, s):
+        s.create("/k", value="1")
+        w = s.watch("/k", since_index=5)
+        assert w.next_event(timeout=0.05) is None
+        s.set("/k", value="2")  # index 2 < 5: still filtered
+        assert w.next_event(timeout=0.05) is None
+
+    def test_since_cleared_index_raises_401(self, s):
+        small = Store(history_capacity=3, clock=s.clock)
+        for i in range(6):
+            small.set("/k", value=str(i))
+        with pytest.raises(errors.EtcdError) as ei:
+            small.watch("/k", since_index=1)
+        assert ei.value.code == errors.ECODE_EVENT_INDEX_CLEARED
+
+    def test_delete_dir_notifies_watcher_below(self, s):
+        s.create("/d/sub/leaf", value="v")
+        w = s.watch("/d/sub/leaf")
+        s.delete("/d", recursive=True)
+        e = w.next_event(timeout=1)
+        assert e.action == DELETE
+        assert e.node.key == "/d"  # the deleted ancestor's event
+
+    def test_expire_notifies_watcher(self, s, clock):
+        s.create("/t", value="v", expire_time=clock.t + 5)
+        w = s.watch("/t")
+        clock.t += 10
+        s.delete_expired_keys(clock.t)
+        e = w.next_event(timeout=1)
+        assert e.action == EXPIRE
+
+
+class TestPersistence:
+    def test_save_recovery_roundtrip(self, s, clock):
+        s.create("/a/b", value="v1", expire_time=clock.t + 50)
+        s.create("/a/c", value="v2")
+        s.create("/d", is_dir=True)
+        blob = s.save()
+        s2 = Store(clock=clock)
+        s2.recovery(blob)
+        assert s2.current_index == s.current_index
+        assert s2.get("/a/b").node.value == "v1"
+        assert s2.get("/a/b").node.ttl == 50
+        assert s2.get("/d").node.dir
+        # TTL heap was rebuilt: expiry still works post-recovery.
+        clock.t += 100
+        evs = s2.delete_expired_keys(clock.t)
+        assert [e.node.key for e in evs] == ["/a/b"]
+
+    def test_recovery_clears_watchers(self, s):
+        w = s.watch("/k")
+        blob = s.save()
+        s.recovery(blob)
+        assert s.watcher_hub.count == 0
+        assert w.next_event(timeout=0.1) is None
+
+    def test_clone_independent(self, s):
+        s.create("/k", value="1")
+        c = s.clone()
+        s.set("/k", value="2")
+        assert c.get("/k").node.value == "1"
+        assert s.get("/k").node.value == "2"
+        assert c.current_index == 1 and s.current_index == 2
+
+    def test_save_is_json(self, s):
+        s.create("/k", value="v")
+        d = json.loads(s.save())
+        assert d["currentIndex"] == 1
+
+
+class TestStats:
+    def test_counters(self, s):
+        s.create("/k", value="v")
+        s.get("/k")
+        with pytest.raises(errors.EtcdError):
+            s.get("/nope")
+        s.set("/k", value="2")
+        with pytest.raises(errors.EtcdError):
+            s.compare_and_swap("/k", "wrong", 0, "3")
+        st = s.json_stats()
+        assert st["createSuccess"] == 1
+        assert st["getsSuccess"] == 1 and st["getsFail"] == 1
+        assert st["setsSuccess"] == 1
+        assert st["compareAndSwapFail"] == 1
+
+    def test_index_error_carries_current_index(self, s):
+        s.create("/k", value="v")
+        with pytest.raises(errors.EtcdError) as ei:
+            s.get("/nope")
+        assert ei.value.index == 1

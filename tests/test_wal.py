@@ -92,6 +92,26 @@ class TestWalBasic:
         w.save(HardState(term=1, vote=0, commit=0), [])
         w.save(HardState(term=1, vote=0, commit=0), [])
         assert w.fsync_count == base + 1
+        # Commit-only advance is recorded but not fsynced (MustSync rule)...
+        w.save(HardState(term=1, vote=0, commit=5), [])
+        assert w.fsync_count == base + 1
+        w.close()
+        # ...yet still replayable (close() syncs the tail).
+        w = WAL.open(d)
+        _, st, _ = w.read_all()
+        assert st.commit == 5
+        w.close()
+
+    def test_stray_wal_file_ignored(self, tmp_path):
+        d = wal_dir(tmp_path)
+        w = WAL.create(d)
+        w.save(HardState(term=1, vote=1, commit=1), ents((1, 1)))
+        w.close()
+        with open(os.path.join(d, "stray.wal"), "w") as f:
+            f.write("not a wal segment")
+        w = WAL.open(d)
+        _, _, es = w.read_all()
+        assert [e.index for e in es] == [1]
         w.close()
 
 
