@@ -1,0 +1,106 @@
+"""Run a full etcd-tpu member (or proxy) in-process.
+
+The assembly the reference does in etcdmain/etcd.go:127-231 startEtcd:
+build the peer transport, the EtcdServer, and the peer + client HTTP
+listeners, wired together. Used by the `etcdmain` CLI entry point, the
+integration test tier (§4 T4) and the functional chaos tester.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Sequence, Tuple
+from urllib.parse import urlsplit
+
+from etcd_tpu.etcdhttp.client import ClientAPI
+from etcd_tpu.etcdhttp.peer import PeerAPI
+from etcd_tpu.etcdhttp.web import HttpServer, Router
+from etcd_tpu.rafthttp import HttpTransport
+from etcd_tpu.server.server import EtcdServer, ServerConfig
+
+
+def _listen_addr(url: str) -> Tuple[str, int]:
+    u = urlsplit(url)
+    return u.hostname or "127.0.0.1", u.port or 0
+
+
+@dataclass
+class EtcdConfig:
+    """The subset of etcdmain flags an embedded member needs
+    (reference etcdmain/config.go:139-208)."""
+    name: str
+    data_dir: str
+    initial_cluster: Dict[str, Sequence[str]]
+    listen_peer_urls: Sequence[str] = ()
+    listen_client_urls: Sequence[str] = ()
+    advertise_client_urls: Sequence[str] = ()
+    cluster_token: str = "etcd-cluster"
+    snap_count: int = 10000
+    tick_ms: int = 100
+    election_ticks: int = 10
+    request_timeout: float = 5.0
+
+
+class Etcd:
+    """One running member: EtcdServer + peer listener + client listener(s)."""
+
+    def __init__(self, cfg: EtcdConfig) -> None:
+        self.cfg = cfg
+        peer_urls = (tuple(cfg.listen_peer_urls) or
+                     tuple(cfg.initial_cluster.get(cfg.name, ())))
+        if not peer_urls:
+            raise ValueError(f"no peer URLs for member {cfg.name!r}")
+        client_urls = tuple(cfg.listen_client_urls)
+
+        scfg = ServerConfig(
+            name=cfg.name, data_dir=cfg.data_dir,
+            initial_cluster={k: tuple(v)
+                             for k, v in cfg.initial_cluster.items()},
+            cluster_token=cfg.cluster_token,
+            client_urls=tuple(cfg.advertise_client_urls) or client_urls,
+            snap_count=cfg.snap_count, tick_ms=cfg.tick_ms,
+            election_ticks=cfg.election_ticks,
+            request_timeout=cfg.request_timeout)
+
+        self.transport = HttpTransport()
+        self.server = EtcdServer(scfg, self.transport)
+
+        # Peer listener(s) — one per peer URL (reference etcd.go:133-160).
+        self.peer_http = []
+        papi = PeerAPI(self.server)
+        for url in peer_urls:
+            router = Router()
+            papi.install(router)
+            host, port = _listen_addr(url)
+            self.peer_http.append(HttpServer(host, port, router))
+
+        # Client listener(s) (reference etcd.go:163-180,211-229).
+        self.client_http = []
+        self.client_api = ClientAPI(self.server)
+        for url in client_urls:
+            router = Router()
+            self.client_api.install(router)
+            host, port = _listen_addr(url)
+            self.client_http.append(HttpServer(host, port, router))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        for h in self.peer_http + self.client_http:
+            h.start()
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+        for h in self.peer_http + self.client_http:
+            h.stop()
+
+    def wait_leader(self, timeout: float = 10.0) -> bool:
+        return self.server.lead_elected_ev.wait(timeout)
+
+    @property
+    def client_urls(self) -> Tuple[str, ...]:
+        return tuple(h.url for h in self.client_http)
+
+    @property
+    def peer_urls(self) -> Tuple[str, ...]:
+        return tuple(h.url for h in self.peer_http)

@@ -25,6 +25,8 @@ ECODE_TTL_NAN = 202
 ECODE_INDEX_NAN = 203
 ECODE_INVALID_FIELD = 209
 ECODE_INVALID_FORM = 210
+ECODE_REFRESH_VALUE = 212
+ECODE_REFRESH_TTL_REQUIRED = 213
 
 # Raft-related errors.
 ECODE_RAFT_INTERNAL = 300
@@ -48,6 +50,8 @@ _MESSAGES = {
     ECODE_INDEX_NAN: "The given index in POST form is not a number",
     ECODE_INVALID_FIELD: "Invalid field",
     ECODE_INVALID_FORM: "Invalid POST form",
+    ECODE_REFRESH_VALUE: "Value provided on refresh",
+    ECODE_REFRESH_TTL_REQUIRED: "A TTL must be provided on refresh",
     ECODE_RAFT_INTERNAL: "Raft Internal Error",
     ECODE_LEADER_ELECT: "During Leader Election",
     ECODE_WATCHER_CLEARED: "watcher is cleared due to etcd recovery",

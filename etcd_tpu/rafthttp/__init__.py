@@ -1,0 +1,1 @@
+from etcd_tpu.rafthttp.transport import HttpTransport  # noqa: F401

@@ -171,6 +171,15 @@ class Cluster:
 
     # -- validation (pre-propose) -------------------------------------------
 
+    def version(self) -> Optional[str]:
+        """The decided cluster version, stored replicated at /0/version
+        (reference cluster.go Version / monitorVersions)."""
+        try:
+            e = self.store.get(CLUSTER_VERSION_KEY)
+        except errors.EtcdError:
+            return None
+        return e.node.value if e.node else None
+
     def validate_conf_change(self, cc_type: str, mid: int,
                              peer_urls: Sequence[str] = ()) -> None:
         """Reject impossible membership changes before proposing (reference

@@ -36,6 +36,7 @@ from etcd_tpu.server.cluster import Cluster, Member, STORE_KEYS_PREFIX
 from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, METHOD_QGET, METHOD_SYNC,
                                      Request)
+from etcd_tpu.server.stats import LeaderStats, ServerStats
 from etcd_tpu.server.storage import ServerStorage, read_wal
 from etcd_tpu.server.transport import Transporter
 from etcd_tpu.snap import Snapshotter
@@ -88,6 +89,8 @@ class EtcdServer:
         self.cfg = cfg
         self.clock = clock
         self.transport = transport
+        if hasattr(transport, "bind"):
+            transport.bind(self)
         self.store = Store(clock=clock)
         touch_dir_all(cfg.snapdir)
         self.snapshotter = Snapshotter(cfg.snapdir)
@@ -102,12 +105,15 @@ class EtcdServer:
         self._removed_self = False
         self._sync_elapsed = 0
         self.lead_elected_ev = threading.Event()
+        self._version_proposed = False
 
         if wal_exists(cfg.waldir):
             self._restart()
         else:
             self._bootstrap_new()
         self.reqid = idutil.Generator(self.id & 0xFFFF)
+        self.stats = ServerStats(cfg.name, self.id, clock=clock)
+        self.lstats = LeaderStats(self.id)
 
         # Wire known peers into the transport.
         for m in self.cluster.members():
@@ -220,7 +226,7 @@ class EtcdServer:
     def process(self, m: Message) -> None:
         """Inbound raft message from the transport (reference
         server.go:387-404): drop traffic from removed members."""
-        if self.cluster.is_id_removed(m.frm):
+        if self._stop_ev.is_set() or self.cluster.is_id_removed(m.frm):
             return
         self._inq.put(("msg", m))
 
@@ -327,9 +333,26 @@ class EtcdServer:
             if self._removed_self:
                 self._stop_ev.set()
 
+    def cluster_version(self) -> str:
+        """The negotiated cluster version served at /version (reference
+        monitorVersions server.go:933-973; minimal negotiation: the leader
+        proposes its own version once, members adopt the replicated value)."""
+        from etcd_tpu import version as ver
+        return self.cluster.version() or ver.MIN_CLUSTER_VERSION
+
     def _on_tick(self) -> None:
         if self.is_leader():
+            self.stats.become_leader()
             self.lead_elected_ev.set()
+            if not self._version_proposed and self.cluster.version() is None:
+                from etcd_tpu import version as ver
+                self._version_proposed = True
+                r = Request(id=self.reqid.next(), method=METHOD_PUT,
+                            path=cl.CLUSTER_VERSION_KEY, val=ver.VERSION)
+                try:
+                    self.node.propose(r.encode())
+                except ProposalDroppedError:
+                    self._version_proposed = False
             self._sync_elapsed += 1
             if (self._sync_elapsed >= self.cfg.sync_ticks):
                 self._sync_elapsed = 0
@@ -341,6 +364,7 @@ class EtcdServer:
                     except ProposalDroppedError:
                         pass
         elif self.leader_id != raftpb.NO_LEADER:
+            self.stats.become_follower(self.leader_id)
             self.lead_elected_ev.set()
         if not self._published and self.leader_id != raftpb.NO_LEADER:
             self._publish()
@@ -423,13 +447,16 @@ class EtcdServer:
             return st.create(r.path, is_dir=r.dir, value=r.val, unique=True,
                              expire_time=exp)
         if r.method == METHOD_PUT:
+            if r.refresh:
+                # TTL-only move: value kept, watchers not notified
+                # (reference apply_v2.go Put refresh path).
+                return st.update(r.path, None, exp, refresh=True)
             if r.prev_exist is not None:
                 if r.prev_exist:
                     if r.prev_index or r.prev_value:
                         return st.compare_and_swap(r.path, r.prev_value,
                                                    r.prev_index, r.val, exp)
-                    return st.update(r.path, r.val, exp,
-                                     keep_ttl=r.refresh)
+                    return st.update(r.path, r.val, exp)
                 return st.create(r.path, is_dir=r.dir, value=r.val,
                                  expire_time=exp)
             if r.prev_index or r.prev_value:

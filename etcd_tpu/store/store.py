@@ -178,9 +178,11 @@ class Store:
 
     def update(self, node_path: str, value: Optional[str] = None,
                expire_time: Optional[float] = None,
-               keep_ttl: bool = False) -> Event:
+               refresh: bool = False) -> Event:
         """Update an EXISTING node in place: value (files only) and/or TTL;
-        createdIndex is preserved (reference store.go:208-260)."""
+        createdIndex is preserved (reference store.go:208-260). With
+        refresh=True only the TTL moves: the stored value is kept and
+        watchers are NOT notified (documented v2 refresh semantics)."""
         node_path = normalize(node_path)
         with self._lock:
             try:
@@ -197,17 +199,20 @@ class Store:
                                            cause=node_path,
                                            index=self.current_index)
                 if not n.is_dir:
-                    n.write(value or "", next_index)
+                    if refresh:
+                        n.modified_index = next_index  # value untouched
+                    else:
+                        n.write(value or "", next_index)
                 else:
                     n.modified_index = next_index
-                if not keep_ttl:
-                    n.expire_time = expire_time
-                    self.ttl_heap.push(n)
+                n.expire_time = expire_time
+                self.ttl_heap.push(n)
                 self.current_index = next_index
                 e = Event(ev.UPDATE,
                           node=n.as_extern(now, materialize_children=False),
                           prev_node=prev_ex, etcd_index=self.current_index)
-                self.watcher_hub.notify(e)
+                if not refresh:
+                    self.watcher_hub.notify(e)
                 self.stats.inc("updateSuccess")
                 return e
             except errors.EtcdError:

@@ -79,6 +79,7 @@ class WatcherHub:
         window and a matching event already happened, deliver it immediately
         (reference watcher_hub.go:55-109)."""
         w = Watcher(self, key, recursive, stream, since_index)
+        w.start_index = current_index  # X-Etcd-Index for the watch response
         with self._lock:
             if since_index > 0:
                 e = self.event_history.scan(key, recursive, since_index)

@@ -1,0 +1,121 @@
+"""Client SDK tests against a real HTTP cluster (reference client/ tests +
+integration usage patterns)."""
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.client import Client, KeysAPI, KeysError, MembersAPI
+from etcd_tpu.embed import Etcd, EtcdConfig
+from tests.test_http import free_ports
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sdkcluster")
+    n = 3
+    ports = free_ports(2 * n)
+    peer_urls = {f"m{i}": [f"http://127.0.0.1:{ports[i]}"] for i in range(n)}
+    members = []
+    for i in range(n):
+        cfg = EtcdConfig(
+            name=f"m{i}", data_dir=str(tmp / f"m{i}"),
+            initial_cluster=peer_urls,
+            listen_client_urls=[f"http://127.0.0.1:{ports[n + i]}"],
+            tick_ms=10, request_timeout=5.0)
+        members.append(Etcd(cfg))
+    for m in members:
+        m.start()
+    assert all(m.wait_leader(10) for m in members)
+    yield members
+    for m in members:
+        m.stop()
+
+
+@pytest.fixture()
+def kapi(cluster):
+    c = Client([cluster[0].client_urls[0]])
+    return KeysAPI(c)
+
+
+def test_set_get_delete(kapi):
+    r = kapi.set("/sdk/a", "1")
+    assert r.action == "set" and r.node.value == "1"
+    r = kapi.get("/sdk/a")
+    assert r.node.value == "1" and r.index > 0
+    r = kapi.delete("/sdk/a")
+    assert r.action == "delete"
+    with pytest.raises(KeysError) as ei:
+        kapi.get("/sdk/a")
+    assert ei.value.code == 100
+
+
+def test_create_update_cas(kapi):
+    r = kapi.create("/sdk/c", "v0")
+    assert r.action == "create"
+    with pytest.raises(KeysError) as ei:
+        kapi.create("/sdk/c", "again")
+    assert ei.value.code == 105
+    r = kapi.update("/sdk/c", "v1")
+    assert r.action == "update"
+    r = kapi.set("/sdk/c", "v2", prev_value="v1")
+    assert r.action == "compareAndSwap"
+    r = kapi.set("/sdk/c", "v3", prev_index=r.node.modified_index)
+    assert r.action == "compareAndSwap"
+
+
+def test_create_in_order(kapi):
+    r1 = kapi.create_in_order("/sdk/q", "one")
+    r2 = kapi.create_in_order("/sdk/q", "two")
+    assert r1.node.key < r2.node.key
+    r = kapi.get("/sdk/q", recursive=True, sorted=True)
+    assert [n.value for n in r.node.nodes] == ["one", "two"]
+
+
+def test_quorum_get(kapi):
+    kapi.set("/sdk/qr", "qv")
+    assert kapi.get("/sdk/qr", quorum=True).node.value == "qv"
+
+
+def test_dir_ttl(kapi):
+    r = kapi.set("/sdk/ttldir", dir=True, ttl=100)
+    assert r.node.dir and r.node.ttl >= 99
+
+
+def test_watcher_follows_changes(kapi):
+    kapi.set("/sdk/w", "w0")
+    w = kapi.watcher("/sdk/w")
+    got = []
+
+    def run():
+        for _ in range(2):
+            got.append(w.next(timeout=10).node.value)
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(0.3)
+    kapi.set("/sdk/w", "w1")
+    # Watcher must pick up w2 even though it was written between polls.
+    kapi.set("/sdk/w", "w2")
+    th.join(timeout=15)
+    assert not th.is_alive() and got == ["w1", "w2"]
+
+
+def test_failover_and_sync(cluster):
+    c = Client(["http://127.0.0.1:1", cluster[1].client_urls[0]],
+               timeout=2.0)
+    kapi = KeysAPI(c)
+    assert kapi.set("/sdk/fo", "x").node.value == "x"  # dead endpoint skipped
+    c.sync()
+    assert len(c.endpoints) == 3
+
+
+def test_members_api(cluster):
+    c = Client([cluster[0].client_urls[0]])
+    mapi = MembersAPI(c)
+    ms = mapi.list()
+    assert len(ms) == 3 and all(m.client_urls for m in ms)
+    lead = mapi.leader()
+    assert lead is not None
+    lead_srv = next(m for m in cluster if m.server.is_leader())
+    assert int(lead.id, 16) == lead_srv.server.id

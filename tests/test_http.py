@@ -1,0 +1,338 @@
+"""HTTP API + HTTP peer-transport integration tests (§4 T4 analogue over
+real listeners): a localhost cluster of embed.Etcd members exercising the
+/v2/keys matrix, headers, watches, members/stats/version/health endpoints —
+modeled on reference integration/v2_http_kv_test.go and cluster_test.go.
+"""
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from etcd_tpu.embed import Etcd, EtcdConfig
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def req(method, url, body=None, headers=None, timeout=10.0):
+    """Returns (status, headers, parsed-json-or-text)."""
+    r = urllib.request.Request(url, data=body, method=method,
+                               headers=headers or {})
+    try:
+        resp = urllib.request.urlopen(r, timeout=timeout)
+        status, hdrs, data = resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        status, hdrs, data = e.code, dict(e.headers), e.read()
+    try:
+        parsed = json.loads(data) if data else None
+    except json.JSONDecodeError:
+        parsed = data.decode()
+    return status, hdrs, parsed
+
+
+def form(d):
+    from urllib.parse import urlencode
+    return urlencode(d).encode()
+
+
+FORM_HDR = {"Content-Type": "application/x-www-form-urlencoded"}
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("httpcluster")
+    n = 3
+    ports = free_ports(2 * n)
+    peer_urls = {f"m{i}": [f"http://127.0.0.1:{ports[i]}"] for i in range(n)}
+    members = []
+    for i in range(n):
+        name = f"m{i}"
+        cfg = EtcdConfig(
+            name=name, data_dir=str(tmp / name),
+            initial_cluster=peer_urls,
+            listen_client_urls=[f"http://127.0.0.1:{ports[n + i]}"],
+            tick_ms=10, request_timeout=5.0)
+        members.append(Etcd(cfg))
+    for m in members:
+        m.start()
+    assert all(m.wait_leader(10) for m in members)
+    yield members
+    for m in members:
+        m.stop()
+
+
+def curl(cluster, method, path, body=None, headers=None, member=0):
+    base = cluster[member].client_urls[0]
+    return req(method, base + path, body, headers)
+
+
+class TestKeys:
+    def test_set_get_roundtrip(self, cluster):
+        st, hd, body = curl(cluster, "PUT", "/v2/keys/foo",
+                            form({"value": "bar"}), FORM_HDR)
+        assert st == 200 and body["action"] == "set"
+        assert body["node"]["key"] == "/foo"
+        assert body["node"]["value"] == "bar"
+        assert int(hd["X-Etcd-Index"]) >= 1
+        assert "X-Etcd-Cluster-ID" in hd
+
+        st, hd, body = curl(cluster, "GET", "/v2/keys/foo")
+        assert st == 200 and body["action"] == "get"
+        assert body["node"]["value"] == "bar"
+
+    def test_get_missing_404(self, cluster):
+        st, hd, body = curl(cluster, "GET", "/v2/keys/nope")
+        assert st == 404
+        assert body["errorCode"] == 100
+        assert body["message"] == "Key not found"
+
+    def test_create_in_order_post(self, cluster):
+        st, _, b1 = curl(cluster, "POST", "/v2/keys/queue",
+                         form({"value": "a"}), FORM_HDR)
+        assert st == 201 and b1["action"] == "create"
+        st, _, b2 = curl(cluster, "POST", "/v2/keys/queue",
+                         form({"value": "b"}), FORM_HDR)
+        k1 = int(b1["node"]["key"].rsplit("/", 1)[1])
+        k2 = int(b2["node"]["key"].rsplit("/", 1)[1])
+        assert k2 > k1
+        st, _, body = curl(cluster, "GET",
+                           "/v2/keys/queue?recursive=true&sorted=true")
+        vals = [n["value"] for n in body["node"]["nodes"]]
+        assert vals == ["a", "b"]
+
+    def test_cas(self, cluster):
+        curl(cluster, "PUT", "/v2/keys/cas", form({"value": "one"}),
+             FORM_HDR)
+        st, _, body = curl(cluster, "PUT",
+                           "/v2/keys/cas?prevValue=two",
+                           form({"value": "three"}), FORM_HDR)
+        assert st == 412 or st == 400  # compare failed
+        assert body["errorCode"] == 101
+        st, _, body = curl(cluster, "PUT",
+                           "/v2/keys/cas?prevValue=one",
+                           form({"value": "two"}), FORM_HDR)
+        assert st == 200 and body["action"] == "compareAndSwap"
+        assert body["prevNode"]["value"] == "one"
+
+    def test_cad(self, cluster):
+        curl(cluster, "PUT", "/v2/keys/cad", form({"value": "x"}), FORM_HDR)
+        st, _, body = curl(cluster, "DELETE",
+                           "/v2/keys/cad?prevValue=wrong")
+        assert body["errorCode"] == 101
+        st, _, body = curl(cluster, "DELETE", "/v2/keys/cad?prevValue=x")
+        assert st == 200 and body["action"] == "compareAndDelete"
+
+    def test_prev_exist_create(self, cluster):
+        st, _, body = curl(cluster, "PUT",
+                           "/v2/keys/pe?prevExist=false",
+                           form({"value": "v"}), FORM_HDR)
+        assert st == 201 and body["action"] == "create"
+        st, _, body = curl(cluster, "PUT",
+                           "/v2/keys/pe?prevExist=false",
+                           form({"value": "v2"}), FORM_HDR)
+        assert body["errorCode"] == 105  # already exists
+        st, _, body = curl(cluster, "PUT",
+                           "/v2/keys/pe?prevExist=true",
+                           form({"value": "v2"}), FORM_HDR)
+        assert st == 200 and body["action"] == "update"
+
+    def test_dir_and_recursive_delete(self, cluster):
+        curl(cluster, "PUT", "/v2/keys/d/a", form({"value": "1"}), FORM_HDR)
+        curl(cluster, "PUT", "/v2/keys/d/b", form({"value": "2"}), FORM_HDR)
+        st, _, body = curl(cluster, "GET", "/v2/keys/d")
+        assert body["node"]["dir"] is True
+        st, _, body = curl(cluster, "DELETE", "/v2/keys/d")
+        assert body["errorCode"] == 102  # not a file
+        st, _, body = curl(cluster, "DELETE", "/v2/keys/d?dir=true")
+        assert body["errorCode"] == 108  # dir not empty
+        st, _, body = curl(cluster, "DELETE",
+                           "/v2/keys/d?recursive=true")
+        assert st == 200 and body["action"] == "delete"
+
+    def test_ttl_visible(self, cluster):
+        st, _, body = curl(cluster, "PUT", "/v2/keys/ttlkey",
+                           form({"value": "v", "ttl": "100"}), FORM_HDR)
+        assert st == 200
+        assert body["node"]["ttl"] >= 99
+        assert "expiration" in body["node"]
+
+    def test_refresh_keeps_value_extends_ttl(self, cluster):
+        curl(cluster, "PUT", "/v2/keys/rfr",
+             form({"value": "keepme", "ttl": "5"}), FORM_HDR)
+        st, _, body = curl(cluster, "PUT",
+                           "/v2/keys/rfr?refresh=true",
+                           form({"ttl": "500"}), FORM_HDR)
+        assert st == 200, body
+        assert body["node"]["value"] == "keepme"
+        assert body["node"]["ttl"] > 400
+        st, _, body = curl(cluster, "GET", "/v2/keys/rfr")
+        assert body["node"]["value"] == "keepme"
+        assert body["node"]["ttl"] > 400
+        # refresh without a TTL is rejected (code 213)
+        st, _, body = curl(cluster, "PUT", "/v2/keys/rfr?refresh=true")
+        assert body["errorCode"] == 213
+        # refresh with a value is rejected (code 212)
+        st, _, body = curl(cluster, "PUT",
+                           "/v2/keys/rfr?refresh=true",
+                           form({"value": "x", "ttl": "5"}), FORM_HDR)
+        assert body["errorCode"] == 212
+
+    def test_path_escape_rejected(self, cluster):
+        # ".." must not reach the internal /0 cluster tree.
+        st, _, body = curl(cluster, "GET", "/v2/keys/%2e%2e/0")
+        assert st == 400 and body["errorCode"] == 210
+        st, _, body = curl(cluster, "DELETE",
+                           "/v2/keys/../0?recursive=true")
+        assert st == 400 and body["errorCode"] == 210
+        # Membership survived.
+        st, _, body = curl(cluster, "GET", "/v2/members")
+        assert len(body["members"]) == 3
+
+    def test_no_value_on_success(self, cluster):
+        st, _, body = curl(cluster, "PUT",
+                           "/v2/keys/nv?noValueOnSuccess=true",
+                           form({"value": "big"}), FORM_HDR)
+        assert st in (200, 201)
+        assert "node" not in body and "prevNode" not in body
+        st, _, body = curl(cluster, "GET", "/v2/keys/nv")
+        assert body["node"]["value"] == "big"
+
+    def test_quorum_get(self, cluster):
+        curl(cluster, "PUT", "/v2/keys/qg", form({"value": "q"}), FORM_HDR)
+        st, _, body = curl(cluster, "GET", "/v2/keys/qg?quorum=true",
+                           member=1)
+        assert st == 200 and body["node"]["value"] == "q"
+
+    def test_bad_field_values(self, cluster):
+        st, _, body = curl(cluster, "GET", "/v2/keys/foo?recursive=bogus")
+        assert body["errorCode"] == 209
+        st, _, body = curl(cluster, "PUT", "/v2/keys/foo?prevIndex=nan",
+                           form({"value": "v"}), FORM_HDR)
+        assert body["errorCode"] == 203
+        st, _, body = curl(cluster, "PUT", "/v2/keys/foo",
+                           form({"value": "v", "ttl": "bogus"}), FORM_HDR)
+        assert body["errorCode"] == 202
+        st, _, body = curl(cluster, "GET",
+                           "/v2/keys/foo?wait=true&quorum=true")
+        assert body["errorCode"] == 209
+
+    def test_follower_serves_writes(self, cluster):
+        # Any member takes writes; consensus routes to the leader.
+        for i in range(3):
+            st, _, body = curl(cluster, "PUT", f"/v2/keys/via{i}",
+                               form({"value": str(i)}), FORM_HDR, member=i)
+            assert st in (200, 201)
+        for i in range(3):
+            st, _, body = curl(cluster, "GET", f"/v2/keys/via{i}",
+                               member=(i + 1) % 3)
+            assert body["node"]["value"] == str(i)
+
+
+class TestWatch:
+    def test_longpoll_watch(self, cluster):
+        results = {}
+
+        def watcher():
+            results["resp"] = curl(cluster, "GET",
+                                   "/v2/keys/watched?wait=true", member=1)
+
+        th = threading.Thread(target=watcher)
+        th.start()
+        time.sleep(0.3)
+        curl(cluster, "PUT", "/v2/keys/watched", form({"value": "now"}),
+             FORM_HDR)
+        th.join(timeout=10)
+        assert not th.is_alive()
+        st, hd, body = results["resp"]
+        assert st == 200 and body["action"] == "set"
+        assert body["node"]["value"] == "now"
+
+    def test_wait_index_history(self, cluster):
+        st, _, body = curl(cluster, "PUT", "/v2/keys/hist",
+                           form({"value": "h1"}), FORM_HDR)
+        idx = body["node"]["modifiedIndex"]
+        # waitIndex in the past replays from the event history ring.
+        st, _, body = curl(cluster, "GET",
+                           f"/v2/keys/hist?wait=true&waitIndex={idx}")
+        assert st == 200 and body["node"]["value"] == "h1"
+
+    def test_stream_watch(self, cluster):
+        base = cluster[0].client_urls[0]
+        got = []
+        done = threading.Event()
+
+        def streamer():
+            r = urllib.request.Request(
+                base + "/v2/keys/s?wait=true&stream=true&recursive=true")
+            with urllib.request.urlopen(r, timeout=15) as resp:
+                for _ in range(2):
+                    line = resp.readline()
+                    got.append(json.loads(line))
+            done.set()
+
+        th = threading.Thread(target=streamer, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        curl(cluster, "PUT", "/v2/keys/s/1", form({"value": "a"}), FORM_HDR)
+        curl(cluster, "PUT", "/v2/keys/s/2", form({"value": "b"}), FORM_HDR)
+        assert done.wait(15)
+        assert [e["node"]["value"] for e in got] == ["a", "b"]
+
+
+class TestMeta:
+    def test_members_list(self, cluster):
+        st, _, body = curl(cluster, "GET", "/v2/members")
+        assert st == 200
+        assert len(body["members"]) == 3
+        m = body["members"][0]
+        assert set(m) == {"id", "name", "peerURLs", "clientURLs"}
+        assert all(mm["clientURLs"] for mm in body["members"])
+
+    def test_member_add_conflict(self, cluster):
+        taken = cluster[0].peer_urls[0]
+        st, _, body = curl(cluster, "POST", "/v2/members",
+                           json.dumps({"peerURLs": [taken]}).encode(),
+                           {"Content-Type": "application/json"})
+        assert st == 409
+
+    def test_machines(self, cluster):
+        st, _, body = curl(cluster, "GET", "/v2/machines")
+        assert st == 200 and "http://" in body
+
+    def test_stats(self, cluster):
+        st, _, body = curl(cluster, "GET", "/v2/stats/self")
+        assert st == 200
+        assert body["state"] in ("StateLeader", "StateFollower")
+        leader = next(i for i, m in enumerate(cluster)
+                      if m.server.is_leader())
+        st, _, body = curl(cluster, "GET", "/v2/stats/leader",
+                           member=leader)
+        assert st == 200
+        assert len(body["followers"]) == 2
+        for f in body["followers"].values():
+            assert f["counts"]["success"] > 0
+        st, _, body = curl(cluster, "GET", "/v2/stats/store")
+        assert st == 200 and "watchers" in body
+
+    def test_version_and_health(self, cluster):
+        st, _, body = curl(cluster, "GET", "/version")
+        assert st == 200 and body["etcdserver"].startswith("2.")
+        st, _, body = curl(cluster, "GET", "/health")
+        assert st == 200 and body["health"] == "true"
+
+    def test_404_paths(self, cluster):
+        st, _, _ = curl(cluster, "GET", "/v2/bogus")
+        assert st == 404
