@@ -1,0 +1,7 @@
+"""`python -m etcd_tpu` — the `etcd` binary equivalent (reference main.go)."""
+import sys
+
+from etcd_tpu.etcdmain import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,7 +45,10 @@ class Client:
     """A cluster-aware HTTP client; thread-safe."""
 
     def __init__(self, endpoints: Sequence[str], timeout: float = 5.0,
-                 username: str = "", password: str = "") -> None:
+                 username: str = "", password: str = "",
+                 proxy: str = "") -> None:
+        """proxy: optional HTTP proxy URL all requests are routed through
+        (reference discovery newProxyFunc + http.Transport.Proxy)."""
         if not endpoints:
             raise ValueError("at least one endpoint required")
         self._lock = threading.Lock()
@@ -53,6 +56,9 @@ class Client:
         self.timeout = timeout
         self.username = username
         self.password = password
+        if proxy and "://" not in proxy:
+            proxy = "http://" + proxy
+        self.proxy = proxy
 
     @property
     def endpoints(self) -> List[str]:
@@ -82,6 +88,16 @@ class Client:
                      timeout: float) -> HttpResponse:
         r = urllib.request.Request(endpoint + path, data=body,
                                    method=method, headers=headers)
+        if self.proxy:
+            from urllib.parse import urlsplit
+            pu = urlsplit(self.proxy)
+            host = pu.hostname + (f":{pu.port}" if pu.port else "")
+            r.set_proxy(host, urlsplit(endpoint).scheme or "http")
+            if pu.username:
+                import base64
+                cred = base64.b64encode(
+                    f"{pu.username}:{pu.password or ''}".encode()).decode()
+                r.add_header("Proxy-Authorization", f"Basic {cred}")
         if self.username:
             import base64
             cred = base64.b64encode(

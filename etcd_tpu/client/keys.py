@@ -57,14 +57,17 @@ _FORM_HDR = {"Content-Type": "application/x-www-form-urlencoded"}
 
 
 class KeysAPI:
-    def __init__(self, client: Client) -> None:
+    def __init__(self, client: Client, prefix: str = "/v2/keys") -> None:
+        """prefix="" talks to services exposing the keyspace at the root,
+        e.g. the public discovery service (reference keys.go
+        NewKeysAPIWithPrefix, discovery.go:101)."""
         self.client = client
+        self.prefix = prefix
 
     # -- plumbing -----------------------------------------------------------
 
-    @staticmethod
-    def _key_path(key: str) -> str:
-        return "/v2/keys" + quote("/" + key.strip("/"))
+    def _key_path(self, key: str) -> str:
+        return self.prefix + quote("/" + key.strip("/"))
 
     def _call(self, method: str, key: str, params: dict,
               form: Optional[dict] = None,

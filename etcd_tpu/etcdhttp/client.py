@@ -111,6 +111,7 @@ class ClientAPI:
             return
         try:
             r = self._parse_key_request(ctx, suffix)
+            no_value = _parse_bool(ctx, "noValueOnSuccess")
             if self.security is not None:
                 self.security.check_key_access(ctx, r)
             result = self.server.do(r)
@@ -118,9 +119,7 @@ class ClientAPI:
             self._error(ctx, e)
             return
         if isinstance(result, Event):
-            self._write_key_event(ctx, result,
-                                  no_value=_parse_bool(ctx,
-                                                       "noValueOnSuccess"))
+            self._write_key_event(ctx, result, no_value=no_value)
         else:  # a Watcher from store.watch
             self._handle_watch(ctx, r, result)
 
@@ -213,7 +212,11 @@ class ClientAPI:
     def _write_key_event(self, ctx: Ctx, e: Event,
                          no_value: bool = False) -> None:
         """reference writeKeyEvent client.go:536-551."""
-        status = 201 if e.action in _CREATED_ACTIONS else 200
+        # IsCreated (reference store/event.go:48-58): an explicit create, or
+        # a set that made a new node (no prevNode), answers 201.
+        created = (e.action in _CREATED_ACTIONS or
+                   (e.action == "set" and e.prev_node is None))
+        status = 201 if created else 200
         d = e.to_dict()
         if no_value and e.action in ("set", "update", "create",
                                      "compareAndSwap", "compareAndDelete"):

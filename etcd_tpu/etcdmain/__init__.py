@@ -1,0 +1,4 @@
+from etcd_tpu.etcdmain.config import MainConfig, ConfigError, parse_args
+from etcd_tpu.etcdmain.etcd import main
+
+__all__ = ["MainConfig", "ConfigError", "parse_args", "main"]

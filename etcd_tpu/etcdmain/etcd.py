@@ -1,0 +1,208 @@
+"""Process entry for `etcd-tpu` (python -m etcd_tpu).
+
+Behavioral equivalent of reference etcdmain/etcd.go Main(): parse
+flags/env, default the data dir from the member name (etcd.go:96-99),
+identify whether the data dir was previously a member or a proxy
+(identifyDataDirOrDie etcd.go:376-404) and start the matching mode;
+discovery full-cluster errors fall back to proxy mode when configured
+(etcd.go:99-107).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional, Sequence
+
+from etcd_tpu.embed import Etcd, EtcdConfig
+from etcd_tpu.etcdhttp.web import HttpServer, Router
+from etcd_tpu.etcdmain.config import (ConfigError, MainConfig,
+                                      PROXY_READONLY, parse_args)
+from etcd_tpu.proxy import Director, ReverseProxy, fetch_cluster_urls, readonly
+
+log = logging.getLogger("etcdmain")
+
+DIR_MEMBER, DIR_PROXY, DIR_EMPTY = "member", "proxy", "empty"
+
+
+def identify_data_dir(dir_: str) -> str:
+    """Which mode this data dir was used for (reference etcd.go:376-404)."""
+    try:
+        names = os.listdir(dir_)
+    except FileNotFoundError:
+        return DIR_EMPTY
+    m = DIR_MEMBER in names
+    p = DIR_PROXY in names
+    if m and p:
+        raise ConfigError(
+            "invalid datadir: both member and proxy directories exist")
+    return DIR_MEMBER if m else DIR_PROXY if p else DIR_EMPTY
+
+
+def start_etcd(cfg: MainConfig) -> Etcd:
+    """Launch a consensus member (reference startEtcd etcd.go:127-231)."""
+    initial_cluster = dict(cfg.initial_cluster)
+    token = cfg.initial_cluster_token
+    if cfg.discovery or cfg.discovery_srv:
+        from etcd_tpu.discovery import (join_cluster, srv_cluster)
+        if not os.path.isdir(os.path.join(cfg.data_dir, "member")):
+            if cfg.discovery:
+                s = join_cluster(cfg.discovery, cfg.name,
+                                 cfg.initial_advertise_peer_urls,
+                                 proxy_url=cfg.discovery_proxy)
+            else:
+                s = srv_cluster(cfg.discovery_srv, cfg.name,
+                                cfg.initial_advertise_peer_urls)
+            from etcd_tpu.etcdmain.config import parse_initial_cluster
+            initial_cluster = parse_initial_cluster(s)
+            token = cfg.discovery or cfg.discovery_srv
+
+    ecfg = EtcdConfig(
+        name=cfg.name,
+        data_dir=cfg.data_dir,
+        initial_cluster=initial_cluster,
+        listen_peer_urls=cfg.listen_peer_urls,
+        listen_client_urls=cfg.listen_client_urls,
+        advertise_client_urls=cfg.advertise_client_urls,
+        cluster_token=token,
+        snap_count=cfg.snapshot_count,
+        tick_ms=cfg.heartbeat_interval,
+        election_ticks=cfg.election_ticks,
+    )
+    e = Etcd(ecfg)
+    e.start()
+    log.info("etcd-tpu member %s listening: client=%s peer=%s",
+             cfg.name, e.client_urls, e.peer_urls)
+    return e
+
+
+class ProxyServer:
+    """Proxy mode: stateless fan-out to cluster members, endpoint view
+    persisted in <data-dir>/proxy/cluster (reference startProxy
+    etcdmain/etcd.go:234-335)."""
+
+    def __init__(self, cfg: MainConfig) -> None:
+        self.cfg = cfg
+        proxy_dir = os.path.join(cfg.data_dir, DIR_PROXY)
+        os.makedirs(proxy_dir, exist_ok=True)
+        self._clusterfile = os.path.join(proxy_dir, "cluster")
+
+        if os.path.exists(self._clusterfile):
+            with open(self._clusterfile) as f:
+                self._peer_urls = json.load(f)["PeerURLs"]
+            log.info("proxy: using peer urls %s from cluster file",
+                     self._peer_urls)
+        else:
+            self._peer_urls = [u for urls in cfg.initial_cluster.values()
+                               for u in urls]
+            if cfg.discovery:
+                from etcd_tpu.discovery import get_cluster
+                from etcd_tpu.etcdmain.config import parse_initial_cluster
+                s = get_cluster(cfg.discovery, proxy_url=cfg.discovery_proxy)
+                self._peer_urls = [u for urls in
+                                   parse_initial_cluster(s).values()
+                                   for u in urls]
+
+        self.director = Director(self._refresh_urls)
+        rp = ReverseProxy(self.director)
+        handler = readonly(rp.handle) if cfg.is_readonly_proxy else rp.handle
+        self.http: List[HttpServer] = []
+        for url in cfg.listen_client_urls:
+            from etcd_tpu.embed import _listen_addr
+            host, port = _listen_addr(url)
+            router = Router()
+            router.add("/", handler)
+            self.http.append(HttpServer(host, port, router))
+
+    def _refresh_urls(self) -> List[str]:
+        client_urls, peer_urls = fetch_cluster_urls(self._peer_urls)
+        if peer_urls:
+            self._peer_urls = peer_urls
+            tmp = self._clusterfile + ".bak"
+            with open(tmp, "w") as f:
+                json.dump({"PeerURLs": peer_urls}, f)
+            os.replace(tmp, self._clusterfile)
+        return client_urls
+
+    @property
+    def client_urls(self) -> List[str]:
+        return [h.url for h in self.http]
+
+    def start(self) -> None:
+        for h in self.http:
+            h.start()
+        log.info("proxy: listening on %s", self.client_urls)
+
+    def stop(self) -> None:
+        self.director.stop()
+        for h in self.http:
+            h.stop()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s: %(message)s")
+    try:
+        cfg = parse_args(sys.argv[1:] if argv is None else argv)
+    except ConfigError as e:
+        print(f"error verifying flags, {e}. See 'etcd-tpu --help'.",
+              file=sys.stderr)
+        return 1
+    if cfg.debug:
+        logging.getLogger().setLevel(logging.DEBUG)
+
+    if not cfg.data_dir:
+        cfg.data_dir = f"{cfg.name}.etcd"
+        log.info("no data-dir provided, using default data-dir ./%s",
+                 cfg.data_dir)
+
+    try:
+        which = identify_data_dir(cfg.data_dir)
+    except ConfigError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if which != DIR_EMPTY:
+        log.info("already initialized as %s before, starting as etcd %s...",
+                 which, which)
+
+    stop_ev = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop_ev.set())
+        except ValueError:
+            pass  # not the main thread (tests)
+
+    if cfg.is_proxy and which == DIR_MEMBER:
+        # Refuse rather than plant a proxy/ dir beside member/ — that would
+        # make the data dir permanently unidentifiable.
+        print(f"cannot start as proxy: data dir {cfg.data_dir} was "
+              f"previously initialized as a member", file=sys.stderr)
+        return 1
+
+    runner = None
+    should_proxy = cfg.is_proxy or which == DIR_PROXY
+    if not should_proxy:
+        try:
+            runner = start_etcd(cfg)
+        except Exception as e:
+            from etcd_tpu.discovery import FullClusterError
+            if (isinstance(e, FullClusterError) and
+                    cfg.should_fallback_to_proxy):
+                log.info("discovery cluster full, falling back to proxy")
+                should_proxy = True
+            else:
+                print(str(e), file=sys.stderr)
+                return 1
+    if should_proxy:
+        runner = ProxyServer(cfg)
+        runner.start()
+
+    try:
+        stop_ev.wait()
+    finally:
+        runner.stop()
+    return 0

@@ -1,0 +1,243 @@
+"""etcdmain config parsing/validation + data-dir identification + proxy mode
+(reference etcdmain/config.go Parse validations, etcd.go identifyDataDirOrDie,
+proxy/ director+reverse tests)."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.embed import Etcd, EtcdConfig
+from etcd_tpu.etcdmain import ConfigError, parse_args
+from etcd_tpu.etcdmain.config import MainConfig, parse_initial_cluster
+from etcd_tpu.etcdmain.etcd import (DIR_EMPTY, DIR_MEMBER, DIR_PROXY,
+                                    ProxyServer, identify_data_dir)
+from etcd_tpu.proxy import Director, ReverseProxy, readonly
+from etcd_tpu.etcdhttp.web import HttpServer, Router
+
+from test_http import free_ports, req, form, FORM_HDR
+
+
+# -- flag/env parsing ---------------------------------------------------------
+
+def test_parse_defaults():
+    cfg = parse_args([], env={})
+    assert cfg.name == "default"
+    assert cfg.initial_cluster == {"default": ["http://localhost:2380"]}
+    assert cfg.listen_client_urls == ("http://localhost:2379",)
+    assert cfg.heartbeat_interval == 100 and cfg.election_timeout == 1000
+    assert not cfg.is_proxy
+
+
+def test_parse_initial_cluster_multi_url():
+    ic = parse_initial_cluster(
+        "a=http://1.1.1.1:2380,b=http://2.2.2.2:2380,a=http://1.1.1.1:7001")
+    assert ic == {"a": ["http://1.1.1.1:2380", "http://1.1.1.1:7001"],
+                  "b": ["http://2.2.2.2:2380"]}
+    with pytest.raises(ConfigError):
+        parse_initial_cluster("no-equals-sign")
+
+
+def test_initial_cluster_defaults_from_name():
+    cfg = parse_args(["--name", "infra0"], env={})
+    assert cfg.initial_cluster == {"infra0": ["http://localhost:2380"]}
+
+
+def test_env_fallback_and_flag_precedence():
+    env = {"ETCD_NAME": "fromenv", "ETCD_SNAPSHOT_COUNT": "42",
+           "ETCD_FORCE_NEW_CLUSTER": "true"}
+    cfg = parse_args([], env=env)
+    assert cfg.name == "fromenv"
+    assert cfg.snapshot_count == 42
+    assert cfg.force_new_cluster is True
+    # Command line wins over env (pkg/flags/flag.go:68-77).
+    cfg = parse_args(["--name", "fromflag"], env=env)
+    assert cfg.name == "fromflag"
+
+
+def test_conflicting_bootstrap_flags():
+    with pytest.raises(ConfigError):
+        parse_args(["--initial-cluster", "a=http://x:1",
+                    "--discovery", "http://disc/tok"], env={})
+    with pytest.raises(ConfigError):
+        parse_args(["--discovery-srv", "example.com",
+                    "--discovery", "http://disc/tok"], env={})
+
+
+def test_advertise_required_with_listen():
+    with pytest.raises(ConfigError):
+        parse_args(["--listen-client-urls", "http://127.0.0.1:9999"], env={})
+    # but fine for proxies, and fine when advertise is given
+    parse_args(["--listen-client-urls", "http://127.0.0.1:9999",
+                "--proxy", "on"], env={})
+    parse_args(["--listen-client-urls", "http://127.0.0.1:9999",
+                "--advertise-client-urls", "http://127.0.0.1:9999"], env={})
+
+
+def test_election_timeout_validation():
+    with pytest.raises(ConfigError):
+        parse_args(["--heartbeat-interval", "300"], env={})
+    cfg = parse_args(["--heartbeat-interval", "50",
+                      "--election-timeout", "500"], env={})
+    assert cfg.election_ticks == 10
+
+
+# -- data dir identification --------------------------------------------------
+
+def test_identify_data_dir(tmp_path):
+    assert identify_data_dir(str(tmp_path / "nope")) == DIR_EMPTY
+    d = tmp_path / "m"
+    (d / "member").mkdir(parents=True)
+    assert identify_data_dir(str(d)) == DIR_MEMBER
+    p = tmp_path / "p"
+    (p / "proxy").mkdir(parents=True)
+    assert identify_data_dir(str(p)) == DIR_PROXY
+    (p / "member").mkdir()
+    with pytest.raises(ConfigError):
+        identify_data_dir(str(p))
+
+
+# -- proxy mode ---------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def one_member(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("proxytgt")
+    pport, cport = free_ports(2)
+    cfg = EtcdConfig(
+        name="m0", data_dir=str(tmp / "m0"),
+        initial_cluster={"m0": [f"http://127.0.0.1:{pport}"]},
+        listen_client_urls=[f"http://127.0.0.1:{cport}"],
+        advertise_client_urls=[f"http://127.0.0.1:{cport}"],
+        tick_ms=10, request_timeout=5.0)
+    m = Etcd(cfg)
+    m.start()
+    assert m.wait_leader(10)
+    yield m
+    m.stop()
+
+
+def _proxy_for(one_member, tmp_path, extra=None):
+    cfg = MainConfig()
+    cfg.data_dir = str(tmp_path / "pxy")
+    cfg.proxy = "on" if extra is None else extra
+    cfg.initial_cluster = {"m0": list(one_member.peer_urls)}
+    cfg.listen_client_urls = ("http://127.0.0.1:0",)
+    p = ProxyServer(cfg)
+    p.start()
+    # force a synchronous endpoint refresh so the test never races the
+    # 30s director cycle
+    p.director.refresh()
+    return p
+
+
+def test_proxy_forwards_kv(one_member, tmp_path):
+    p = _proxy_for(one_member, tmp_path)
+    try:
+        base = p.client_urls[0]
+        st, hdrs, body = req("PUT", base + "/v2/keys/pfoo",
+                             form({"value": "bar"}), FORM_HDR)
+        assert st == 201 and body["node"]["value"] == "bar"
+        assert "X-Etcd-Index" in hdrs
+        st, _, body = req("GET", base + "/v2/keys/pfoo")
+        assert st == 200 and body["node"]["value"] == "bar"
+        # cluster file got persisted with the member's peer URLs
+        with open(os.path.join(cfg_dir(p), "cluster")) as f:
+            assert json.load(f)["PeerURLs"] == list(one_member.peer_urls)
+    finally:
+        p.stop()
+
+
+def cfg_dir(p):
+    return os.path.join(p.cfg.data_dir, "proxy")
+
+
+def test_readonly_proxy_rejects_writes(one_member, tmp_path):
+    p = _proxy_for(one_member, tmp_path, extra="readonly")
+    try:
+        base = p.client_urls[0]
+        st, _, _ = req("PUT", base + "/v2/keys/rofoo",
+                       form({"value": "x"}), FORM_HDR)
+        assert st == 501
+        st, _, _ = req("GET", base + "/v2/keys/")
+        assert st == 200
+    finally:
+        p.stop()
+
+
+def test_proxy_no_endpoints_503():
+    d = Director(lambda: [], refresh_interval=3600)
+    rp = ReverseProxy(d)
+    router = Router()
+    router.add("/", rp.handle)
+    h = HttpServer("127.0.0.1", 0, router)
+    h.start()
+    try:
+        st, _, body = req("GET", h.url + "/v2/keys/x")
+        assert st == 503
+    finally:
+        d.stop()
+        h.stop()
+
+
+def test_env_bad_int_is_config_error():
+    with pytest.raises(ConfigError):
+        parse_args([], env={"ETCD_SNAPSHOT_COUNT": "abc"})
+
+
+def test_member_dir_refuses_proxy_mode(tmp_path):
+    from etcd_tpu.etcdmain.etcd import main
+    d = tmp_path / "was-member"
+    (d / "member").mkdir(parents=True)
+    assert main(["--proxy", "on", "--data-dir", str(d)]) == 1
+    # No proxy/ dir was planted beside member/.
+    assert identify_data_dir(str(d)) == DIR_MEMBER
+
+
+def test_proxy_passes_watch_longpoll(one_member, tmp_path):
+    """A wait=true long-poll parks at the proxy until the member answers
+    (the reference proxy has no response deadline — reverse.go)."""
+    p = _proxy_for(one_member, tmp_path)
+    try:
+        base = p.client_urls[0]
+        got = {}
+
+        def watch():
+            got["resp"] = req("GET", base + "/v2/keys/lpk?wait=true",
+                              timeout=30)
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        time.sleep(0.5)  # let the long-poll park
+        st, _, _ = req("PUT", base + "/v2/keys/lpk", form({"value": "now"}),
+                       FORM_HDR)
+        assert st == 201
+        t.join(timeout=15)
+        assert not t.is_alive(), "watch through proxy never completed"
+        st, _, body = got["resp"]
+        assert st == 200 and body["node"]["value"] == "now"
+        # the member was never quarantined by the parked poll
+        assert len(p.director.endpoints()) >= 1
+    finally:
+        p.stop()
+
+
+def test_proxy_fails_over_dead_endpoint(one_member, tmp_path):
+    (dead,) = free_ports(1)
+    urls = [f"http://127.0.0.1:{dead}"] + list(one_member.client_urls)
+    d = Director(lambda: urls, refresh_interval=3600, failure_wait=60)
+    # deterministic order: dead endpoint first
+    d._eps.sort(key=lambda ep: ep.url != f"http://127.0.0.1:{dead}")
+    rp = ReverseProxy(d)
+    router = Router()
+    router.add("/", rp.handle)
+    h = HttpServer("127.0.0.1", 0, router)
+    h.start()
+    try:
+        st, _, body = req("GET", h.url + "/v2/keys/")
+        assert st == 200
+        # the dead endpoint is now quarantined
+        assert len(d.endpoints()) == len(urls) - 1
+    finally:
+        d.stop()
+        h.stop()

@@ -83,7 +83,9 @@ class TestKeys:
     def test_set_get_roundtrip(self, cluster):
         st, hd, body = curl(cluster, "PUT", "/v2/keys/foo",
                             form({"value": "bar"}), FORM_HDR)
-        assert st == 200 and body["action"] == "set"
+        # A set that creates answers 201 (reference store/event.go IsCreated
+        # + client.go writeKeyEvent:546).
+        assert st == 201 and body["action"] == "set"
         assert body["node"]["key"] == "/foo"
         assert body["node"]["value"] == "bar"
         assert int(hd["X-Etcd-Index"]) >= 1
@@ -165,7 +167,7 @@ class TestKeys:
     def test_ttl_visible(self, cluster):
         st, _, body = curl(cluster, "PUT", "/v2/keys/ttlkey",
                            form({"value": "v", "ttl": "100"}), FORM_HDR)
-        assert st == 200
+        assert st == 201
         assert body["node"]["ttl"] >= 99
         assert "expiration" in body["node"]
 
