@@ -73,12 +73,16 @@ class Etcd:
             host, port = _listen_addr(url)
             self.peer_http.append(HttpServer(host, port, router))
 
-        # Client listener(s) (reference etcd.go:163-180,211-229).
+        # Client listener(s) (reference etcd.go:163-180,211-229), with the
+        # v2 security gate + /v2/security routes wired in.
+        from etcd_tpu.etcdhttp.client_security import SecurityHandler
         self.client_http = []
-        self.client_api = ClientAPI(self.server)
+        self.security = SecurityHandler(self.server)
+        self.client_api = ClientAPI(self.server, security=self.security)
         for url in client_urls:
             router = Router()
             self.client_api.install(router)
+            self.security.install(router)
             host, port = _listen_addr(url)
             self.client_http.append(HttpServer(host, port, router))
 

@@ -267,6 +267,12 @@ class ClientAPI:
     def handle_members(self, ctx: Ctx, suffix: str) -> None:
         s = self.server
         h = self._headers()
+        # Mutations need root once security is on (reference client.go:184-187
+        # hasWriteRootAccess).
+        if (self.security is not None and
+                not self.security.check_members_access(ctx)):
+            ctx.send_json(401, {"message": "Insufficient credentials"}, h)
+            return
         try:
             if ctx.method == "GET" and suffix in ("", "/"):
                 body = {"members": [self._member_dict(m)

@@ -1,0 +1,301 @@
+"""/v2/security HTTP surface + request auth gating.
+
+Behavioral equivalent of reference etcdserver/etcdhttp/client_security.go:
+Basic-auth extraction, hasRootAccess (all /v2/security and mutating
+/v2/members calls need the root role once security is on,
+client_security.go:28-70), hasKeyPrefixAccess with guest fallback for
+unauthenticated requests (client_security.go:72-120), the
+users/roles/enable handler trio (client_security.go:135-420), and the
+security capability gate: the endpoints answer 400 until the cluster
+version reaches 2.1.0 (capability.go:16-58, rolling-upgrade safety).
+Security errors answer 400 (http.go:55-57); missing credentials answer
+401 "Insufficient credentials" (client_security.go:122-125).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import logging
+from typing import List, Optional, Tuple
+
+from etcd_tpu import version as ver
+from etcd_tpu.etcdhttp.web import Ctx, Router
+from etcd_tpu.server.security import (GUEST_ROLE, ROOT_ROLE, Role,
+                                      SecurityError, SecurityStore)
+
+log = logging.getLogger("etcdhttp")
+
+SECURITY_PREFIX = "/v2/security"
+
+
+def basic_auth(ctx: Ctx) -> Optional[Tuple[str, str]]:
+    h = ctx.headers.get("Authorization", "")
+    if not h.startswith("Basic "):
+        return None
+    try:
+        raw = base64.b64decode(h[6:]).decode()
+        user, _, pw = raw.partition(":")
+        return user, pw
+    except Exception:
+        return None
+
+
+class SecurityHandler:
+    """Auth gate + /v2/security routes for one member's client listener."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+        self.sec = SecurityStore(server)
+
+    # -- capability gate (reference capability.go) --------------------------
+
+    def _capable(self, ctx: Ctx) -> bool:
+        cv = self.server.cluster_version() or "2.0.0"
+        if ver.parse(cv) >= (2, 1, 0):
+            return True
+        ctx.send_json(400, {"message":
+                            "Not capable of accessing security feature "
+                            "during rolling upgrades."})
+        return False
+
+    # -- access checks ------------------------------------------------------
+
+    def enabled(self) -> bool:
+        return self.sec.enabled()
+
+    def has_root_access(self, ctx: Ctx) -> bool:
+        """reference hasRootAccess client_security.go:34-70."""
+        if not self.enabled():
+            return True
+        cred = basic_auth(ctx)
+        if cred is None:
+            return False
+        username, password = cred
+        try:
+            user = self.sec.get_user(username)
+        except SecurityError:
+            return False
+        if not user.check_password(password):
+            log.info("security: wrong password for user %s", username)
+            return False
+        if ROOT_ROLE in user.roles:
+            return True
+        log.info("security: user %s does not have the %s role", username,
+                 ROOT_ROLE)
+        return False
+
+    def has_write_root_access(self, ctx: Ctx) -> bool:
+        if ctx.method in ("GET", "HEAD"):
+            return True
+        return self.has_root_access(ctx)
+
+    def has_key_prefix_access(self, ctx: Ctx, key: str,
+                              recursive: bool) -> bool:
+        """reference hasKeyPrefixAccess client_security.go:72-104."""
+        if not self.enabled():
+            return True
+        cred = basic_auth(ctx)
+        write = ctx.method not in ("GET", "HEAD")
+        if cred is None:
+            return self._has_guest_access(key, write)
+        username, password = cred
+        try:
+            user = self.sec.get_user(username)
+        except SecurityError:
+            log.info("security: no such user: %s", username)
+            return False
+        if not user.check_password(password):
+            log.info("security: incorrect password for user: %s", username)
+            return False
+        # Grant if ANY role grants. (The reference returns the verdict of
+        # the first resolvable role, client_security.go:92-99 — a known
+        # upstream defect that strands multi-role users on their
+        # alphabetically-first role; we check them all.)
+        for role_name in user.roles:
+            try:
+                role = self.sec.get_role(role_name)
+            except SecurityError:
+                continue
+            ok = (role.has_recursive_access(key, write) if recursive
+                  else role.has_key_access(key, write))
+            if ok:
+                return True
+        log.info("security: invalid access for user %s on key %s",
+                 username, key)
+        return False
+
+    def _has_guest_access(self, key: str, write: bool) -> bool:
+        try:
+            role = self.sec.get_role(GUEST_ROLE)
+        except SecurityError:
+            return False
+        return role.has_key_access(key, write)
+
+    def check_key_access(self, ctx: Ctx, r) -> None:
+        """The ClientAPI /v2/keys gate (reference client.go:135-137).
+        Raises 401 as an API error when access is denied."""
+        from etcd_tpu import errors
+        from etcd_tpu.server.cluster import STORE_KEYS_PREFIX
+        key = r.path
+        if key.startswith(STORE_KEYS_PREFIX):
+            key = key[len(STORE_KEYS_PREFIX):]
+        key = key or "/"  # GET /v2/keys/ normalizes to the bare prefix
+        if not self.has_key_prefix_access(ctx, key, r.recursive):
+            raise errors.EtcdError(errors.ECODE_UNAUTHORIZED,
+                                   cause="Insufficient credentials")
+
+    def check_members_access(self, ctx: Ctx) -> bool:
+        """Mutating /v2/members calls need root once security is on
+        (reference client.go:184-187 hasWriteRootAccess)."""
+        return self.has_write_root_access(ctx)
+
+    # -- routes -------------------------------------------------------------
+
+    def install(self, router: Router) -> None:
+        router.add(SECURITY_PREFIX + "/roles", self.handle_roles)
+        router.add(SECURITY_PREFIX + "/users", self.handle_users)
+        router.add(SECURITY_PREFIX + "/enable", self.handle_enable,
+                   exact=True)
+
+    def _headers(self):
+        return {"X-Etcd-Cluster-ID": f"{self.server.cluster.cluster_id:x}"}
+
+    def _no_auth(self, ctx: Ctx) -> None:
+        ctx.send_json(401, {"message": "Insufficient credentials"})
+
+    def _error(self, ctx: Ctx, e: Exception) -> None:
+        if isinstance(e, SecurityError):
+            ctx.send_json(400, {"message": str(e)})
+        else:
+            ctx.send_json(500, {"message": "Internal Server Error"})
+
+    # /v2/security/roles[/name]
+    def handle_roles(self, ctx: Ctx, suffix: str) -> None:
+        if not self._capable(ctx):
+            return
+        name = suffix.strip("/")
+        if not name:
+            if ctx.method != "GET":
+                ctx.send(405, b"Method Not Allowed",
+                         headers={"Allow": "GET"})
+                return
+            if not self.has_root_access(ctx):
+                return self._no_auth(ctx)
+            try:
+                roles = self.sec.all_roles()
+            except Exception as e:
+                return self._error(ctx, e)
+            ctx.send_json(200, {"roles": roles}, self._headers())
+            return
+        if "/" in name:
+            ctx.send_json(400, {"message": "Invalid path"})
+            return
+        if ctx.method not in ("GET", "PUT", "DELETE"):
+            ctx.send(405, b"Method Not Allowed",
+                     headers={"Allow": "GET, PUT, DELETE"})
+            return
+        if not self.has_root_access(ctx):
+            return self._no_auth(ctx)
+        try:
+            if ctx.method == "GET":
+                role = self.sec.get_role(name)
+                ctx.send_json(200, role.to_dict(), self._headers())
+            elif ctx.method == "PUT":
+                try:
+                    body = json.loads(ctx.body or b"{}")
+                except ValueError:
+                    ctx.send_json(400,
+                                  {"message": "Invalid JSON in request body."})
+                    return
+                if body.get("role") != name:
+                    ctx.send_json(400, {"message":
+                                        "Role JSON name does not match the "
+                                        "name in the URL"})
+                    return
+                role, created = self.sec.create_or_update_role(
+                    name, body.get("permissions"), body.get("grant"),
+                    body.get("revoke"))
+                ctx.send_json(201 if created else 200, role.to_dict(),
+                              self._headers())
+            else:
+                self.sec.delete_role(name)
+                ctx.send(200, b"", headers=self._headers())
+        except Exception as e:
+            self._error(ctx, e)
+
+    # /v2/security/users[/name]
+    def handle_users(self, ctx: Ctx, suffix: str) -> None:
+        if not self._capable(ctx):
+            return
+        name = suffix.strip("/")
+        if not name:
+            if ctx.method != "GET":
+                ctx.send(405, b"Method Not Allowed",
+                         headers={"Allow": "GET"})
+                return
+            if not self.has_root_access(ctx):
+                return self._no_auth(ctx)
+            try:
+                users = self.sec.all_users()
+            except Exception as e:
+                return self._error(ctx, e)
+            ctx.send_json(200, {"users": users}, self._headers())
+            return
+        if "/" in name:
+            ctx.send_json(400, {"message": "Invalid path"})
+            return
+        if ctx.method not in ("GET", "PUT", "DELETE"):
+            ctx.send(405, b"Method Not Allowed",
+                     headers={"Allow": "GET, PUT, DELETE"})
+            return
+        if not self.has_root_access(ctx):
+            return self._no_auth(ctx)
+        try:
+            if ctx.method == "GET":
+                u = self.sec.get_user(name)
+                ctx.send_json(200, u.to_dict(with_password=False),
+                              self._headers())
+            elif ctx.method == "PUT":
+                try:
+                    body = json.loads(ctx.body or b"{}")
+                except ValueError:
+                    ctx.send_json(400,
+                                  {"message": "Invalid JSON in request body."})
+                    return
+                if body.get("user") != name:
+                    ctx.send_json(400, {"message":
+                                        "User JSON name does not match the "
+                                        "name in the URL"})
+                    return
+                u, created = self.sec.create_or_update_user(
+                    name, body.get("password", ""), body.get("roles"),
+                    body.get("grant"), body.get("revoke"))
+                ctx.send_json(201 if created else 200,
+                              u.to_dict(with_password=False), self._headers())
+            else:
+                self.sec.delete_user(name)
+                ctx.send(200, b"", headers=self._headers())
+        except Exception as e:
+            self._error(ctx, e)
+
+    # /v2/security/enable
+    def handle_enable(self, ctx: Ctx, suffix: str) -> None:
+        if not self._capable(ctx):
+            return
+        if ctx.method == "GET":
+            ctx.send_json(200, {"enabled": self.enabled()}, self._headers())
+            return
+        if ctx.method not in ("PUT", "DELETE"):
+            ctx.send(405, b"Method Not Allowed",
+                     headers={"Allow": "GET, PUT, DELETE"})
+            return
+        if not self.has_root_access(ctx):
+            return self._no_auth(ctx)
+        try:
+            if ctx.method == "PUT":
+                self.sec.enable()
+            else:
+                self.sec.disable()
+            ctx.send(200, b"", headers=self._headers())
+        except Exception as e:
+            self._error(ctx, e)

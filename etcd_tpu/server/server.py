@@ -91,7 +91,11 @@ class EtcdServer:
         self.transport = transport
         if hasattr(transport, "bind"):
             transport.bind(self)
-        self.store = Store(clock=clock)
+        # Namespace dirs exist from boot and are write-protected (reference
+        # server.go:173 store.New(StoreClusterPrefix, StoreKeysPrefix)).
+        self.store = Store(clock=clock,
+                           namespaces=(cl.STORE_CLUSTER_PREFIX,
+                                       STORE_KEYS_PREFIX))
         touch_dir_all(cfg.snapdir)
         self.snapshotter = Snapshotter(cfg.snapdir)
         self.raft_storage = MemoryStorage()

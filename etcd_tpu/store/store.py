@@ -92,7 +92,11 @@ class Store:
     HTTP handler threads read/watch (reference worldLock RWMutex)."""
 
     def __init__(self, history_capacity: int = ev.DEFAULT_HISTORY_CAPACITY,
-                 clock: Callable[[], float] = time.time) -> None:
+                 clock: Callable[[], float] = time.time,
+                 namespaces: tuple = ()) -> None:
+        """namespaces: permanent top-level dirs pre-created at boot and
+        write-protected along with "/" (reference store.go:85-96 newStore —
+        the server passes "/0" and "/1")."""
         self._lock = threading.RLock()
         self.clock = clock
         self.root = Node("/", 0, 0, None, is_dir=True)
@@ -100,6 +104,11 @@ class Store:
         self.watcher_hub = WatcherHub(history_capacity)
         self.ttl_heap = TtlKeyHeap()
         self.stats = Stats()
+        self.namespaces = tuple(namespaces)
+        self._readonly = frozenset(self.namespaces) | {"/"}
+        for ns in self.namespaces:
+            n = Node(ns, 0, 0, self.root, is_dir=True)
+            self.root.children[ns.lstrip("/")] = n
 
     # -- reads ---------------------------------------------------------------
 
@@ -186,7 +195,7 @@ class Store:
         node_path = normalize(node_path)
         with self._lock:
             try:
-                if node_path == "/":
+                if node_path in self._readonly:
                     raise errors.EtcdError(errors.ECODE_ROOT_RONLY,
                                            cause="/",
                                            index=self.current_index)
@@ -227,7 +236,7 @@ class Store:
         node_path = normalize(node_path)
         with self._lock:
             try:
-                if node_path == "/":
+                if node_path in self._readonly:
                     raise errors.EtcdError(errors.ECODE_ROOT_RONLY, cause="/",
                                            index=self.current_index)
                 n = self._walk(node_path)
@@ -260,7 +269,7 @@ class Store:
         node_path = normalize(node_path)
         with self._lock:
             try:
-                if node_path == "/":
+                if node_path in self._readonly:
                     raise errors.EtcdError(errors.ECODE_ROOT_RONLY, cause="/",
                                            index=self.current_index)
                 if recursive:
@@ -356,7 +365,8 @@ class Store:
     def clone(self) -> "Store":
         """Deep copy for async snapshot marshal (reference store.go:646)."""
         with self._lock:
-            s = Store(self.watcher_hub.event_history.capacity, self.clock)
+            s = Store(self.watcher_hub.event_history.capacity, self.clock,
+                      namespaces=self.namespaces)
             s.root = self.root.clone(None)
             s.current_index = self.current_index
             s.stats = self.stats.clone()
@@ -443,7 +453,7 @@ class Store:
             node_path = posixpath.join(normalize(node_path),
                                        f"{next_index:020d}")
         node_path = normalize(node_path)
-        if node_path == "/":
+        if node_path in self._readonly:
             raise errors.EtcdError(errors.ECODE_ROOT_RONLY, cause="/",
                                    index=self.current_index)
         dirname, name = posixpath.split(node_path)

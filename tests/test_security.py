@@ -1,0 +1,246 @@
+"""v2 auth tests: permission algebra units (reference security_test.go) +
+live HTTP enforcement over a real member (reference client_security.go
+handlers + hasKeyPrefixAccess/hasRootAccess gating)."""
+import base64
+import json
+
+import pytest
+
+from etcd_tpu.embed import Etcd, EtcdConfig
+from etcd_tpu.server.security import (ROOT_ROLE, RWPermission, Role,
+                                      SecurityError, User, check_password,
+                                      hash_password)
+
+from test_http import free_ports, req, form, FORM_HDR
+
+
+# -- unit: permission algebra -------------------------------------------------
+
+def test_password_hash_roundtrip():
+    h = hash_password("s3cret")
+    assert h.startswith("pbkdf2$")
+    assert check_password(h, "s3cret")
+    assert not check_password(h, "wrong")
+    assert not check_password("garbage", "s3cret")
+
+
+def test_simple_and_prefix_match():
+    rw = RWPermission(read=["/foo/*"], write=["/foo/bar"])
+    assert rw.has_access("/foo/baz", write=False)
+    assert not rw.has_access("/other", write=False)
+    assert rw.has_access("/foo/bar", write=True)
+    assert not rw.has_access("/foo/baz", write=True)
+    # recursive access needs a trailing-* pattern (prefixMatch)
+    assert rw.has_recursive_access("/foo/", write=False)
+    assert not rw.has_recursive_access("/foo/", write=True)
+
+
+def test_grant_revoke():
+    rw = RWPermission(read=["/a"], write=[])
+    rw2 = rw.grant(RWPermission(read=["/b"], write=["/w"]))
+    assert rw2.read == ["/a", "/b"] and rw2.write == ["/w"]
+    with pytest.raises(SecurityError):
+        rw2.grant(RWPermission(read=["/a"]))  # duplicate grant errors
+    rw3 = rw2.revoke(RWPermission(read=["/a"], write=["/nope"]))
+    assert rw3.read == ["/b"] and rw3.write == ["/w"]
+
+
+def test_user_merge():
+    u = User("alice", hash_password("pw"), ["r1"])
+    m = u.merge("", ["r2"], [])
+    assert m.roles == ["r1", "r2"] and m.password == u.password
+    m2 = m.merge("newpw", [], ["r1"])
+    assert m2.roles == ["r2"] and check_password(m2.password, "newpw")
+
+
+def test_root_role_almighty():
+    r = Role(ROOT_ROLE)
+    assert r.has_key_access("/anything", write=True)
+    assert r.has_recursive_access("/anything", write=True)
+
+
+# -- live HTTP enforcement ----------------------------------------------------
+
+def _auth_hdr(user, pw):
+    cred = base64.b64encode(f"{user}:{pw}".encode()).decode()
+    return {"Authorization": f"Basic {cred}"}
+
+
+def _jhdr(extra=None):
+    h = {"Content-Type": "application/json"}
+    h.update(extra or {})
+    return h
+
+
+@pytest.fixture(scope="module")
+def member(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sec")
+    pport, cport = free_ports(2)
+    cfg = EtcdConfig(
+        name="s0", data_dir=str(tmp / "s0"),
+        initial_cluster={"s0": [f"http://127.0.0.1:{pport}"]},
+        listen_client_urls=[f"http://127.0.0.1:{cport}"],
+        tick_ms=10, request_timeout=5.0)
+    m = Etcd(cfg)
+    m.start()
+    assert m.wait_leader(10)
+    yield m
+    m.stop()
+
+
+@pytest.fixture(scope="module")
+def base(member):
+    return member.client_urls[0]
+
+
+def test_security_lifecycle(base):
+    # 1. enable without root user is refused
+    st, _, body = req("PUT", base + "/v2/security/enable")
+    assert st == 400 and "root user" in body["message"]
+
+    # 2. create root user
+    st, _, body = req("PUT", base + "/v2/security/users/root",
+                      json.dumps({"user": "root",
+                                  "password": "rootpw"}).encode(), _jhdr())
+    assert st == 201, body
+    assert "password" not in body and body["user"] == "root"
+
+    # 3. restrict the guest role BEFORE enabling: read-everything,
+    # write-nothing (the default auto-created guest is fully permissive)
+    st, _, body = req("PUT", base + "/v2/security/roles/guest",
+                      json.dumps({"role": "guest", "permissions": {
+                          "kv": {"read": ["/*"], "write": []}}}).encode(),
+                      _jhdr())
+    assert st == 201, body
+
+    # 4. a limited role + user
+    st, _, body = req("PUT", base + "/v2/security/roles/appRole",
+                      json.dumps({"role": "appRole", "permissions": {
+                          "kv": {"read": ["/app/*"],
+                                 "write": ["/app/*"]}}}).encode(), _jhdr())
+    assert st == 201, body
+    st, _, body = req("PUT", base + "/v2/security/users/alice",
+                      json.dumps({"user": "alice",
+                                  "password": "alicepw"}).encode(), _jhdr())
+    assert st == 201, body
+    st, _, body = req("PUT", base + "/v2/security/users/alice",
+                      json.dumps({"user": "alice",
+                                  "grant": ["appRole"]}).encode(), _jhdr())
+    assert st == 200 and body["roles"] == ["appRole"], body
+
+    # 5. enable security (needs nothing yet — no auth enforced until on)
+    st, _, body = req("PUT", base + "/v2/security/enable")
+    assert st == 200, body
+    st, _, body = req("GET", base + "/v2/security/enable")
+    assert st == 200 and body["enabled"] is True
+
+    # 6. now /v2/security requires root credentials
+    st, _, body = req("GET", base + "/v2/security/users")
+    assert st == 401
+    st, _, body = req("GET", base + "/v2/security/users",
+                      headers=_auth_hdr("root", "rootpw"))
+    assert st == 200 and set(body["users"]) == {"alice", "root"}
+    st, _, _ = req("GET", base + "/v2/security/users",
+                   headers=_auth_hdr("root", "WRONG"))
+    assert st == 401
+
+    # 7. guest (unauthenticated) can read but not write
+    st, _, _ = req("GET", base + "/v2/keys/")
+    assert st == 200
+    st, _, body = req("PUT", base + "/v2/keys/app/x", form({"value": "1"}),
+                      FORM_HDR)
+    assert st == 401 and body["errorCode"] == 110
+
+    # 8. alice can write under /app only
+    st, _, _ = req("PUT", base + "/v2/keys/app/x", form({"value": "1"}),
+                   {**FORM_HDR, **_auth_hdr("alice", "alicepw")})
+    assert st == 201
+    st, _, body = req("PUT", base + "/v2/keys/other", form({"value": "1"}),
+                      {**FORM_HDR, **_auth_hdr("alice", "alicepw")})
+    assert st == 401
+    st, _, _ = req("GET", base + "/v2/keys/app/x",
+                   headers=_auth_hdr("alice", "WRONG"))
+    assert st == 401
+
+    # 9. root can do anything
+    st, _, _ = req("PUT", base + "/v2/keys/other", form({"value": "2"}),
+                   {**FORM_HDR, **_auth_hdr("root", "rootpw")})
+    assert st == 201
+
+    # 10. member mutations need root; reads don't
+    st, _, body = req("GET", base + "/v2/members")
+    assert st == 200
+    st, _, body = req("POST", base + "/v2/members",
+                      json.dumps({"peerURLs":
+                                  ["http://127.0.0.1:1"]}).encode(), _jhdr())
+    assert st == 401
+
+    # 11. deleting root while enabled is refused
+    st, _, body = req("DELETE", base + "/v2/security/users/root",
+                      headers=_auth_hdr("root", "rootpw"))
+    assert st == 400 and "root" in body["message"]
+
+    # 12. disable (root required), then everything opens up again
+    st, _, _ = req("DELETE", base + "/v2/security/enable")
+    assert st == 401
+    st, _, _ = req("DELETE", base + "/v2/security/enable",
+                   headers=_auth_hdr("root", "rootpw"))
+    assert st == 200
+    st, _, _ = req("PUT", base + "/v2/keys/free", form({"value": "1"}),
+                   FORM_HDR)
+    assert st == 201
+
+
+def test_role_crud_and_errors(base):
+    # role name mismatch
+    st, _, body = req("PUT", base + "/v2/security/roles/r2",
+                      json.dumps({"role": "other"}).encode(), _jhdr())
+    assert st == 400
+    # modify root role refused
+    st, _, body = req("PUT", base + "/v2/security/roles/root",
+                      json.dumps({"role": "root"}).encode(), _jhdr())
+    assert st == 400 and "root role" in body["message"]
+    # grant/revoke on a role
+    st, _, _ = req("PUT", base + "/v2/security/roles/r2",
+                   json.dumps({"role": "r2", "permissions": {
+                       "kv": {"read": ["/r2/*"], "write": []}}}).encode(),
+                   _jhdr())
+    assert st == 201
+    st, _, body = req("PUT", base + "/v2/security/roles/r2",
+                      json.dumps({"role": "r2", "grant": {
+                          "kv": {"read": [], "write": ["/r2/*"]}}}).encode(),
+                      _jhdr())
+    assert st == 200 and body["permissions"]["kv"]["write"] == ["/r2/*"]
+    # duplicate grant errors
+    st, _, body = req("PUT", base + "/v2/security/roles/r2",
+                      json.dumps({"role": "r2", "grant": {
+                          "kv": {"read": [], "write": ["/r2/*"]}}}).encode(),
+                      _jhdr())
+    assert st == 400
+    st, _, body = req("GET", base + "/v2/security/roles")
+    assert st == 200 and "r2" in body["roles"] and "guest" in body["roles"]
+    st, _, _ = req("DELETE", base + "/v2/security/roles/r2")
+    assert st == 200
+    st, _, body = req("GET", base + "/v2/security/roles/r2")
+    assert st == 400 and "does not exist" in body["message"]
+
+
+def test_auth_survives_restart(member, base, tmp_path_factory):
+    """Auth state rides the replicated store, so it must survive a member
+    crash-restart (WAL replay)."""
+    st, _, _ = req("GET", base + "/v2/security/users/alice",
+                   headers=_auth_hdr("root", "rootpw"))
+    assert st == 200
+    cfg = member.cfg
+    member.stop()
+    m2 = Etcd(cfg)
+    m2.start()
+    assert m2.wait_leader(10)
+    try:
+        b2 = m2.client_urls[0]
+        st, _, body = req("GET", b2 + "/v2/security/users/alice")
+        assert st == 200 and body["roles"] == ["appRole"]
+        st, _, body = req("GET", b2 + "/v2/security/enable")
+        assert st == 200 and body["enabled"] is False  # was disabled above
+    finally:
+        m2.stop()
