@@ -38,12 +38,18 @@ class EtcdConfig:
     tick_ms: int = 100
     election_ticks: int = 10
     request_timeout: float = 5.0
+    initial_cluster_state: str = "new"   # "new" | "existing" (join)
+    force_new_cluster: bool = False
 
 
 class Etcd:
     """One running member: EtcdServer + peer listener + client listener(s)."""
 
     def __init__(self, cfg: EtcdConfig) -> None:
+        if cfg.initial_cluster_state not in ("new", "existing"):
+            raise ValueError(
+                f"initial_cluster_state must be 'new' or 'existing', got "
+                f"{cfg.initial_cluster_state!r}")
         self.cfg = cfg
         peer_urls = (tuple(cfg.listen_peer_urls) or
                      tuple(cfg.initial_cluster.get(cfg.name, ())))
@@ -59,7 +65,9 @@ class Etcd:
             client_urls=tuple(cfg.advertise_client_urls) or client_urls,
             snap_count=cfg.snap_count, tick_ms=cfg.tick_ms,
             election_ticks=cfg.election_ticks,
-            request_timeout=cfg.request_timeout)
+            request_timeout=cfg.request_timeout,
+            new_cluster=cfg.initial_cluster_state != "existing",
+            force_new_cluster=cfg.force_new_cluster)
 
         self.transport = HttpTransport()
         self.server = EtcdServer(scfg, self.transport)

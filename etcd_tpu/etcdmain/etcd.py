@@ -71,6 +71,8 @@ def start_etcd(cfg: MainConfig) -> Etcd:
         snap_count=cfg.snapshot_count,
         tick_ms=cfg.heartbeat_interval,
         election_ticks=cfg.election_ticks,
+        initial_cluster_state=cfg.initial_cluster_state,
+        force_new_cluster=cfg.force_new_cluster,
     )
     e = Etcd(ecfg)
     e.start()

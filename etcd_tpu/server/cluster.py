@@ -277,3 +277,62 @@ class Cluster:
             except errors.EtcdError:
                 pass
             self._members[mid] = nm
+
+
+# -- remote bootstrap helpers (reference etcdserver/cluster_util.go) ----------
+
+def get_cluster_from_remote_peers(peer_urls: Sequence[str],
+                                  timeout: float = 2.0
+                                  ) -> Tuple[int, List[Member]]:
+    """GET /members from each peer URL until one answers; returns
+    (cluster_id, members) — the joiner's view of the existing cluster
+    (reference GetClusterFromRemotePeers cluster_util.go:54-98)."""
+    import http.client
+    from urllib.parse import urlsplit
+
+    for base in peer_urls:
+        u = urlsplit(base)
+        try:
+            conn = http.client.HTTPConnection(u.hostname, u.port,
+                                              timeout=timeout)
+            try:
+                conn.request("GET", "/members")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    continue
+                cid_hex = resp.getheader("X-Etcd-Cluster-ID") or "0"
+                data = json.loads(resp.read().decode())
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            continue
+        members = [Member(id=int(m["id"], 16), name=m.get("name", ""),
+                          peer_urls=tuple(m.get("peerURLs", ())),
+                          client_urls=tuple(m.get("clientURLs", ())))
+                   for m in data.get("members", [])]
+        if members:
+            return int(cid_hex, 16), members
+    raise RuntimeError(
+        f"cannot fetch cluster info from peer urls {list(peer_urls)}")
+
+
+def validate_cluster_and_assign_ids(local: "Cluster",
+                                    existing: List[Member]) -> None:
+    """Match the locally-configured membership (-initial-cluster) against
+    the running cluster's member list by sorted peer URLs, and take over the
+    existing IDs (reference ValidateClusterAndAssignIDs
+    cluster_util.go:103-140). Raises on any mismatch."""
+    ems = sorted(existing, key=lambda m: sorted(m.peer_urls))
+    lms = sorted(local.members(), key=lambda m: sorted(m.peer_urls))
+    if len(ems) != len(lms):
+        raise ValueError(
+            f"member count is unequal: local {len(lms)} vs existing "
+            f"{len(ems)}")
+    for em, lm in zip(ems, lms):
+        if sorted(em.peer_urls) != sorted(lm.peer_urls):
+            raise ValueError(
+                f"unmatched member while checking PeerURLs: local "
+                f"{sorted(lm.peer_urls)} vs existing {sorted(em.peer_urls)}")
+    with local._lock:
+        local._members = {em.id: replace(lm, id=em.id)
+                          for em, lm in zip(ems, lms)}

@@ -70,6 +70,14 @@ class ServerConfig:
     wal_segment_size: int = wal_mod.SEGMENT_SIZE_BYTES
     request_timeout: float = 5.0
     catch_up_entries: int = CATCH_UP_ENTRIES
+    # False = join an existing cluster: fetch membership + IDs from the
+    # peers in initial_cluster instead of founding (reference
+    # server.go:194-217 `!haveWAL && !cfg.NewCluster`).
+    new_cluster: bool = True
+    # Disaster recovery: restart as a one-member cluster, rewriting
+    # membership in the log (reference -force-new-cluster,
+    # etcdserver/raft.go:266-315).
+    force_new_cluster: bool = False
 
     @property
     def waldir(self) -> str:
@@ -112,9 +120,14 @@ class EtcdServer:
         self._version_proposed = False
 
         if wal_exists(cfg.waldir):
-            self._restart()
-        else:
+            if cfg.force_new_cluster:
+                self._restart_standalone()
+            else:
+                self._restart()
+        elif cfg.new_cluster:
             self._bootstrap_new()
+        else:
+            self._bootstrap_join()
         self.reqid = idutil.Generator(self.id & 0xFFFF)
         self.stats = ServerStats(cfg.name, self.id, clock=clock)
         self.lstats = LeaderStats(self.id)
@@ -151,7 +164,47 @@ class EtcdServer:
                    heartbeat_tick=cfg.heartbeat_ticks,
                    storage=self.raft_storage), peers)
 
-    def _restart(self) -> None:
+    def _bootstrap_join(self) -> None:
+        """Join a running cluster (reference server.go:194-217): the admin
+        already proposed this member via the members API; fetch the live
+        membership from the other peers, take over their IDs (matched by
+        peer URLs), and start with an empty log — history replays from the
+        leader (appends or a snapshot)."""
+        cfg = self.cfg
+        local = Cluster.from_initial(self.store, cfg.initial_cluster,
+                                     cfg.cluster_token)
+        me = local.member_by_name(cfg.name)
+        if me is None:
+            raise ValueError(
+                f"member {cfg.name!r} not in initial cluster "
+                f"{sorted(cfg.initial_cluster)}")
+        remote_urls = [u for name, urls in cfg.initial_cluster.items()
+                       if name != cfg.name for u in urls]
+        cid, existing = cl.get_cluster_from_remote_peers(remote_urls)
+        cl.validate_cluster_and_assign_ids(local, existing)
+        local.cluster_id = cid
+        self.cluster = local
+        me = self.cluster.member_by_name(cfg.name)
+        if cfg.client_urls:
+            self.cluster._members[me.id] = Member(
+                me.id, me.name, me.peer_urls, tuple(cfg.client_urls))
+        self.id = me.id
+        metadata = json.dumps({"id": f"{self.id:x}",
+                               "clusterId": f"{cid:x}"}).encode()
+        self.wal = WAL.create(cfg.waldir, metadata,
+                              segment_size=cfg.wal_segment_size)
+        self.storage = ServerStorage(self.wal, self.snapshotter)
+        # No bootstrap peers: membership arrives from the log
+        # (reference startNode(cfg, cl, nil)).
+        self.node = Node.start(
+            Config(id=self.id, election_tick=cfg.election_ticks,
+                   heartbeat_tick=cfg.heartbeat_ticks,
+                   storage=self.raft_storage), peers=[])
+
+    def _recover_from_disk(self):
+        """Shared restart preamble: snapshot → store/raft-storage recovery,
+        cluster from store, WAL replay, identity from WAL metadata. Returns
+        (snap, hard_state, entries)."""
         cfg = self.cfg
         snap = self.snapshotter.load_or_none()
         walsnap = WalSnapshot()
@@ -169,6 +222,85 @@ class EtcdServer:
         md = json.loads(metadata.decode())
         self.id = int(md["id"], 16)
         self.cluster.cluster_id = int(md["clusterId"], 16)
+        return snap, hs, ents
+
+    def _restart_standalone(self) -> None:
+        """-force-new-cluster (reference restartAsStandaloneNode
+        etcdserver/raft.go:266-315): drop uncommitted WAL entries, then
+        append synthesized ConfChanges that remove every other member (and
+        add self if absent) so the survivor forms a quorum of one."""
+        cfg = self.cfg
+        snap, hs, ents = self._recover_from_disk()
+
+        # Discard uncommitted tail (raft.go:273-279).
+        for i, e in enumerate(ents):
+            if e.index > hs.commit:
+                ents = ents[:i]
+                break
+
+        ids = self._member_ids_from_log(snap, ents)
+        to_app = self._create_config_change_ents(
+            ids, self.id, hs.term, hs.commit)
+        ents = list(ents) + to_app
+        self.wal.save(raftpb.HardState(), to_app)
+        if ents:
+            hs = raftpb.replace(hs, commit=ents[-1].index)
+
+        self.storage = ServerStorage(self.wal, self.snapshotter)
+        self.raft_storage.set_hard_state(hs)
+        self.raft_storage.append(ents)
+        self.node = Node.restart(
+            Config(id=self.id, election_tick=cfg.election_ticks,
+                   heartbeat_tick=cfg.heartbeat_ticks,
+                   storage=self.raft_storage))
+
+    @staticmethod
+    def _member_ids_from_log(snap: Optional[Snapshot],
+                             ents: Sequence[Entry]) -> List[int]:
+        """Membership as of the last committed entry (reference getIDs
+        etcdserver/raft.go:322-350)."""
+        ids = set()
+        if snap is not None:
+            ids.update(snap.metadata.conf_state.nodes)
+        for e in ents:
+            if e.type != EntryType.CONF_CHANGE:
+                continue
+            cc = raftpb.decode_conf_change(e.data)
+            if cc.type == ConfChangeType.ADD_NODE:
+                ids.add(cc.node_id)
+            elif cc.type == ConfChangeType.REMOVE_NODE:
+                ids.discard(cc.node_id)
+        return sorted(ids)
+
+    def _create_config_change_ents(self, ids: List[int], self_id: int,
+                                   term: int, index: int) -> List[Entry]:
+        """Synthesized remove-everyone-else (+add-self) entries (reference
+        createConfigChangeEnts etcdserver/raft.go:352-402)."""
+        ents: List[Entry] = []
+        nxt = index + 1
+        found = False
+        for mid in ids:
+            if mid == self_id:
+                found = True
+                continue
+            cc = ConfChange(type=ConfChangeType.REMOVE_NODE, node_id=mid)
+            ents.append(Entry(type=EntryType.CONF_CHANGE, term=term,
+                              index=nxt,
+                              data=raftpb.encode_conf_change(cc)))
+            nxt += 1
+        if not found:
+            me = self.cluster.member(self_id) or Member(
+                self_id, self.cfg.name, ("http://localhost:2380",), ())
+            cc = ConfChange(type=ConfChangeType.ADD_NODE, node_id=self_id,
+                            context=json.dumps(me.to_dict()).encode())
+            ents.append(Entry(type=EntryType.CONF_CHANGE, term=term,
+                              index=nxt,
+                              data=raftpb.encode_conf_change(cc)))
+        return ents
+
+    def _restart(self) -> None:
+        cfg = self.cfg
+        _, hs, ents = self._recover_from_disk()
         self.storage = ServerStorage(self.wal, self.snapshotter)
         self.raft_storage.set_hard_state(hs)
         self.raft_storage.append(ents)

@@ -1,0 +1,156 @@
+"""Bootstrap-path tests: grow a live cluster by joining new members
+(reference integration/cluster_test.go grow scenarios + server.go
+join-existing case) and -force-new-cluster disaster recovery (reference
+etcdserver/raft.go restartAsStandaloneNode + force_cluster_test.go)."""
+import json
+
+import pytest
+
+from etcd_tpu.client import Client, KeysAPI, MembersAPI
+from etcd_tpu.embed import Etcd, EtcdConfig
+
+from test_http import free_ports, req, form, FORM_HDR
+
+
+def _cfg(tmp, name, peers, cport, **kw):
+    return EtcdConfig(
+        name=name, data_dir=str(tmp / name), initial_cluster=peers,
+        listen_client_urls=[f"http://127.0.0.1:{cport}"],
+        advertise_client_urls=[f"http://127.0.0.1:{cport}"],
+        tick_ms=10, request_timeout=5.0, **kw)
+
+
+def test_grow_1_to_3(tmp_path):
+    """member-add via the API, then start the new member with
+    initial-cluster-state=existing: it takes IDs from the running cluster
+    and catches up from the leader's log."""
+    ports = free_ports(6)
+    purl = {i: f"http://127.0.0.1:{ports[i]}" for i in range(3)}
+    peers = {"m0": [purl[0]]}
+    m0 = Etcd(_cfg(tmp_path, "m0", peers, ports[3]))
+    m0.start()
+    assert m0.wait_leader(10)
+    members = [m0]
+    kapi = KeysAPI(Client(list(m0.client_urls)))
+    kapi.set("seed", "1")
+
+    try:
+        for i in (1, 2):
+            # admin proposes the new member first (reference flow)
+            mapi = MembersAPI(Client(list(members[0].client_urls)))
+            mapi.add([purl[i]])
+            grown = dict(peers)
+            grown[f"m{i}"] = [purl[i]]
+            m = Etcd(_cfg(tmp_path, f"m{i}", grown, ports[3 + i],
+                          initial_cluster_state="existing"))
+            m.start()
+            assert m.wait_leader(15), f"m{i} never saw a leader"
+            members.append(m)
+            peers = grown
+
+            # the joiner serves replicated data
+            k = KeysAPI(Client(list(m.client_urls)))
+            assert k.get("seed", quorum=True).node.value == "1"
+            # and accepts writes (forwarded through consensus)
+            k.set(f"from-m{i}", "ok")
+            assert kapi.get(f"from-m{i}",
+                            quorum=True).node.value == "ok"
+
+        st, _, body = req("GET", members[0].client_urls[0] + "/v2/members")
+        assert st == 200 and len(body["members"]) == 3
+        names = sorted(m["name"] for m in body["members"])
+        assert names == ["m0", "m1", "m2"]
+    finally:
+        for m in members:
+            m.stop()
+
+
+def test_join_validates_membership(tmp_path):
+    """A joiner whose initial-cluster doesn't match the running cluster is
+    refused (reference ValidateClusterAndAssignIDs)."""
+    ports = free_ports(4)
+    peers = {"m0": [f"http://127.0.0.1:{ports[0]}"]}
+    m0 = Etcd(_cfg(tmp_path, "m0", peers, ports[2]))
+    m0.start()
+    assert m0.wait_leader(10)
+    try:
+        # no member-add happened; the remote cluster has 1 member but the
+        # joiner claims 2 → count mismatch
+        bad = dict(peers)
+        bad["mX"] = [f"http://127.0.0.1:{ports[1]}"]
+        with pytest.raises(ValueError, match="unequal|unmatched"):
+            Etcd(_cfg(tmp_path, "mX", bad, ports[3],
+                      initial_cluster_state="existing"))
+    finally:
+        m0.stop()
+
+
+def test_force_new_cluster(tmp_path):
+    """Kill a 3-member cluster, restart one member with force-new-cluster:
+    it rewrites membership in its log and serves alone with data intact."""
+    ports = free_ports(6)
+    peers = {f"m{i}": [f"http://127.0.0.1:{ports[i]}"] for i in range(3)}
+    members = [Etcd(_cfg(tmp_path, f"m{i}", peers, ports[3 + i]))
+               for i in range(3)]
+    for m in members:
+        m.start()
+    assert all(m.wait_leader(10) for m in members)
+    kapi = KeysAPI(Client(list(members[0].client_urls)))
+    for i in range(5):
+        kapi.set(f"k{i}", f"v{i}")
+    for m in members:
+        m.stop()
+
+    survivor = Etcd(_cfg(tmp_path, "m0", {"m0": peers["m0"]}, ports[3],
+                         force_new_cluster=True))
+    survivor.start()
+    assert survivor.wait_leader(10), "standalone member failed to lead"
+    try:
+        k = KeysAPI(Client(list(survivor.client_urls)))
+        for i in range(5):
+            assert k.get(f"k{i}", quorum=True).node.value == f"v{i}"
+        # quorum is now 1: writes commit without the dead members
+        k.set("after-disaster", "alive")
+        assert k.get("after-disaster").node.value == "alive"
+        st, _, body = req("GET", survivor.client_urls[0] + "/v2/members")
+        assert st == 200 and len(body["members"]) == 1
+    finally:
+        survivor.stop()
+
+
+def test_force_new_cluster_preserves_uncommitted_discard(tmp_path):
+    """force-new-cluster then normal restart: the rewritten membership
+    persists across a plain restart (WAL carries the synthesized conf
+    changes)."""
+    ports = free_ports(4)
+    peers = {f"m{i}": [f"http://127.0.0.1:{ports[i]}"] for i in range(2)}
+    members = [Etcd(_cfg(tmp_path, f"m{i}", peers, ports[2 + i]))
+               for i in range(2)]
+    for m in members:
+        m.start()
+    assert all(m.wait_leader(10) for m in members)
+    KeysAPI(Client(list(members[0].client_urls))).set("x", "1")
+    for m in members:
+        m.stop()
+
+    s = Etcd(_cfg(tmp_path, "m0", {"m0": peers["m0"]}, ports[2],
+                  force_new_cluster=True))
+    s.start()
+    assert s.wait_leader(10)
+    KeysAPI(Client(list(s.client_urls))).set("y", "2")
+    cfg = s.cfg
+    s.stop()
+
+    # plain restart — no force flag — still a 1-member cluster
+    cfg2 = EtcdConfig(**{**cfg.__dict__, "force_new_cluster": False})
+    s2 = Etcd(cfg2)
+    s2.start()
+    assert s2.wait_leader(10)
+    try:
+        k = KeysAPI(Client(list(s2.client_urls)))
+        assert k.get("x").node.value == "1"
+        assert k.get("y").node.value == "2"
+        st, _, body = req("GET", s2.client_urls[0] + "/v2/members")
+        assert len(body["members"]) == 1
+    finally:
+        s2.stop()
