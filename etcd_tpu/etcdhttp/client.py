@@ -15,6 +15,7 @@ import posixpath
 from typing import Dict, Optional
 
 from etcd_tpu import errors, version as ver
+from etcd_tpu.utils import metrics
 from etcd_tpu.server.cluster import Member, STORE_KEYS_PREFIX
 from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, Request)
@@ -84,6 +85,8 @@ class ClientAPI:
                    exact=True)
         router.add("/version", self.handle_version, exact=True)
         router.add("/health", self.handle_health, exact=True)
+        router.add("/metrics", self.handle_metrics, exact=True)
+        router.add("/debug/vars", self.handle_debug_vars, exact=True)
 
     # -- shared helpers -------------------------------------------------------
 
@@ -366,3 +369,19 @@ class ClientAPI:
         healthy = self.server.leader_id != 0 and not self.server.stopped
         ctx.send_json(200 if healthy else 503,
                       {"health": "true" if healthy else "false"})
+
+    def handle_metrics(self, ctx: Ctx, suffix: str) -> None:
+        """Prometheus text exposition (reference client.go:53,102 wiring
+        prometheus.Handler(); metric set per */metrics.go)."""
+        used, _ = metrics.fd_usage()
+        metrics.file_descriptors_used.set(used)
+        ctx.send(200, metrics.REGISTRY.expose().encode(),
+                 "text/plain; version=0.0.4")
+
+    def handle_debug_vars(self, ctx: Ctx, suffix: str) -> None:
+        """expvar-style JSON (reference client.go:317-331 serveVars:
+        file_descriptor_limit + live raft.status)."""
+        _, limit = metrics.fd_usage()
+        st = self.server.raft_status()
+        ctx.send_json(200, {"file_descriptor_limit": limit,
+                            "raft.status": st})

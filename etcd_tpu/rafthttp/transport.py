@@ -27,6 +27,7 @@ from urllib.parse import urlsplit
 from etcd_tpu.raftpb import Message, MessageType
 from etcd_tpu.etcdhttp.peer import RAFT_PREFIX, encode_frames
 from etcd_tpu.server.transport import Transporter
+from etcd_tpu.utils import metrics
 
 # Reference pipeline.go:36-43: connPerPipeline=4, pipelineBufSize=64.
 SEND_QUEUE_CAP = 4 * 64
@@ -291,7 +292,11 @@ class HttpTransport(Transporter):
         if self._server is not None:
             self._server.lstats.succ(pid, ms)
             self._server.stats.send_append_req(nbytes)
+        metrics.msg_sent_latency.labels(
+            "pipeline", f"{pid:x}", "MsgApp").observe(ms * 1e3)
 
     def _app_failed(self, pid: int) -> None:
         if self._server is not None:
             self._server.lstats.failed(pid)
+        metrics.msg_sent_failed.labels("pipeline", f"{pid:x}",
+                                       "MsgApp").inc()

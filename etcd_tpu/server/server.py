@@ -41,7 +41,7 @@ from etcd_tpu.server.storage import ServerStorage, read_wal
 from etcd_tpu.server.transport import Transporter
 from etcd_tpu.snap import Snapshotter
 from etcd_tpu.store import Store
-from etcd_tpu.utils import idutil
+from etcd_tpu.utils import idutil, metrics
 from etcd_tpu.utils.fileutil import touch_dir_all, purge_files
 from etcd_tpu.utils.wait import Wait
 from etcd_tpu.wal import WAL, WalSnapshot, wal_exists
@@ -346,13 +346,25 @@ class EtcdServer:
                 r = raftpb.replace(r, id=self.reqid.next())
             q = self.wait.register(r.id)
             self._inq.put(("prop", (r.id, r.encode())))
+            # Proposal metrics (reference server.go:523-527,573-575 +
+            # etcdserver/metrics.go).
+            metrics.propose_pending.inc()
+            t0 = time.perf_counter()
             try:
                 result = q.get(timeout=self.cfg.request_timeout)
             except queue.Empty:
                 self.wait.cancel(r.id)
+                metrics.propose_failed.inc()
                 raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
                                        cause="request timed out",
                                        index=self.store.current_index)
+            finally:
+                metrics.propose_pending.dec()
+            # Only committed proposals feed the latency summary (the
+            # reference observes after the successful wait; timeouts would
+            # pin the quantiles at the deadline).
+            metrics.propose_durations.observe(
+                (time.perf_counter() - t0) * 1e3)
             if isinstance(result, errors.EtcdError):
                 raise result
             return result
@@ -432,6 +444,17 @@ class EtcdServer:
     def term(self) -> int:
         return self.node.raft.term
 
+    def raft_status(self) -> dict:
+        """Live raft status JSON for /debug/vars (reference
+        etcdserver/raft.go:60-66 expvar + raft/status.go:52-67). Served by
+        the run-loop thread to avoid torn reads of live raft state."""
+        q: "queue.Queue[dict]" = queue.Queue(maxsize=1)
+        self._inq.put(("status", q))
+        try:
+            return q.get(timeout=self.cfg.request_timeout)
+        except queue.Empty:
+            return {"error": "status request timed out"}
+
     # -- run loop -----------------------------------------------------------
 
     def _run(self) -> None:
@@ -465,6 +488,14 @@ class EtcdServer:
                 except ProposalDroppedError:
                     self.wait.trigger(payload.id, errors.EtcdError(
                         errors.ECODE_LEADER_ELECT, cause="no leader"))
+            elif kind == "status":
+                # Introspection runs on the owning thread so it never tears
+                # a mid-apply view (reference routes Status() through
+                # node.run the same way, raft/node.go status channel).
+                try:
+                    payload.put(self.node.status().to_json())
+                except Exception as e:
+                    payload.put({"error": str(e)})
             self._process_ready()
             if self._removed_self:
                 self._stop_ev.set()

@@ -15,9 +15,11 @@ import struct
 import zlib
 from typing import List, Optional, Tuple
 
+import time
+
 from etcd_tpu import raftpb
 from etcd_tpu.raftpb import Snapshot
-from etcd_tpu.utils import fileutil
+from etcd_tpu.utils import fileutil, metrics
 
 _ENVELOPE = struct.Struct("<IQ")  # crc, len
 
@@ -47,6 +49,14 @@ class Snapshotter:
         (reference snapshotter.go:59-82)."""
         if snapshot.is_empty():
             return
+        t0 = time.perf_counter()
+        try:
+            self._save(snapshot)
+        finally:
+            metrics.snap_save_durations.observe(
+                (time.perf_counter() - t0) * 1e6)
+
+    def _save(self, snapshot: Snapshot) -> None:
         md = snapshot.metadata
         name = snap_name(md.term, md.index)
         body = raftpb.encode_snapshot(snapshot)

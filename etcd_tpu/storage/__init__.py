@@ -1,0 +1,9 @@
+from etcd_tpu.storage.revision import Revision, rev_to_bytes, bytes_to_rev
+from etcd_tpu.storage.backend import Backend
+from etcd_tpu.storage.index import TreeIndex, KeyIndex, RevisionNotFoundError
+from etcd_tpu.storage.kvstore import (KVStore, KeyValue, CompactedError,
+                                      TxnIDMismatchError)
+
+__all__ = ["Revision", "rev_to_bytes", "bytes_to_rev", "Backend",
+           "TreeIndex", "KeyIndex", "RevisionNotFoundError", "KVStore",
+           "KeyValue", "CompactedError", "TxnIDMismatchError"]

@@ -1,0 +1,279 @@
+"""Minimal Prometheus-style metrics registry.
+
+Behavioral equivalent of the reference's vendored prometheus client as used
+by etcdserver/metrics.go, wal/metrics.go, snap/metrics.go and
+rafthttp/metrics.go: counters, gauges, and summaries (count/sum + live
+quantiles over a sliding window) rendered in the Prometheus text exposition
+format at /metrics. Pure stdlib; thread-safe.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str,
+                 registry: Optional["Registry"] = None) -> None:
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        (registry or REGISTRY).register(self)
+
+    def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        raise NotImplementedError
+
+
+class _NullRegistry:
+    """Sentinel registry for child metrics a labeled parent exposes itself."""
+
+    def register(self, m: "_Metric") -> None:
+        pass
+
+
+UNREGISTERED = _NullRegistry()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, registry=None) -> None:
+        self._v = 0.0
+        super().__init__(name, help_, registry)
+
+    def inc(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._v += delta
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+    def samples(self):
+        return [(self.name, {}, self.value)]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str, registry=None) -> None:
+        self._v = 0.0
+        super().__init__(name, help_, registry)
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = v
+
+    def inc(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._v += delta
+
+    def dec(self, delta: float = 1.0) -> None:
+        self.inc(-delta)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+    def samples(self):
+        return [(self.name, {}, self.value)]
+
+
+class Summary(_Metric):
+    """count/sum plus 0.5/0.9/0.99 quantiles over the last `window`
+    observations (the prometheus client's default objectives)."""
+
+    kind = "summary"
+    QUANTILES = (0.5, 0.9, 0.99)
+
+    def __init__(self, name: str, help_: str, window: int = 1024,
+                 registry=None) -> None:
+        self._count = 0
+        self._sum = 0.0
+        self._window: deque = deque(maxlen=window)
+        super().__init__(name, help_, registry)
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            self._window.append(v)
+
+    def samples(self):
+        with self._lock:
+            vals = sorted(self._window)
+            out = []
+            for q in self.QUANTILES:
+                if vals:
+                    idx = min(len(vals) - 1, int(math.ceil(q * len(vals))) - 1)
+                    out.append((self.name, {"quantile": str(q)},
+                                vals[max(idx, 0)]))
+                else:
+                    out.append((self.name, {"quantile": str(q)},
+                                float("nan")))
+            out.append((self.name + "_sum", {}, self._sum))
+            out.append((self.name + "_count", {}, self._count))
+            return out
+
+
+class LabeledSummary(_Metric):
+    """A summary vector keyed by one label (e.g. sendingType or
+    remoteID/sendingType, reference rafthttp/metrics.go)."""
+
+    kind = "summary"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str],
+                 window: int = 1024, registry=None) -> None:
+        self.label_names = tuple(label_names)
+        self._window = window
+        self._children: Dict[Tuple[str, ...], Summary] = {}
+        super().__init__(name, help_, registry)
+
+    def labels(self, *values: str) -> Summary:
+        key = tuple(values)
+        with self._lock:
+            s = self._children.get(key)
+            if s is None:
+                s = Summary(self.name, self.help, self._window,
+                            registry=UNREGISTERED)
+                self._children[key] = s
+            return s
+
+    def samples(self):
+        out = []
+        with self._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            lbls = dict(zip(self.label_names, key))
+            for name, extra, v in child.samples():
+                out.append((name, {**lbls, **extra}, v))
+        return out
+
+
+class LabeledCounter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str],
+                 registry=None) -> None:
+        self.label_names = tuple(label_names)
+        self._children: Dict[Tuple[str, ...], float] = {}
+        super().__init__(name, help_, registry)
+
+    def labels(self, *values: str) -> "_LabeledCounterChild":
+        return _LabeledCounterChild(self, tuple(values))
+
+    def _inc(self, key: Tuple[str, ...], delta: float) -> None:
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + delta
+
+    def samples(self):
+        with self._lock:
+            return [(self.name, dict(zip(self.label_names, key)), v)
+                    for key, v in self._children.items()]
+
+
+class _LabeledCounterChild:
+    def __init__(self, parent: LabeledCounter, key: Tuple[str, ...]) -> None:
+        self._p = parent
+        self._k = key
+
+    def inc(self, delta: float = 1.0) -> None:
+        self._p._inc(self._k, delta)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, m: _Metric) -> None:
+        with self._lock:
+            # Idempotent by name so module reimports/multiple members in one
+            # process share the series (the reference's MustRegister panics;
+            # a shared-process test harness needs tolerance instead).
+            self._metrics.setdefault(m.name, m)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for name, labels, v in m.samples():
+                if labels:
+                    lbl = ",".join(f'{k}="{val}"'
+                                   for k, val in sorted(labels.items()))
+                    series = f"{name}{{{lbl}}}"
+                else:
+                    series = name
+                if isinstance(v, float) and math.isnan(v):
+                    lines.append(f"{series} NaN")
+                else:
+                    lines.append(f"{series} {v}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# -- the reference's metric set ----------------------------------------------
+
+# etcdserver/metrics.go
+propose_durations = Summary(
+    "etcd_server_proposal_durations_milliseconds",
+    "The latency distributions of committing proposal.")
+propose_pending = Gauge(
+    "etcd_server_pending_proposal_total",
+    "The total number of pending proposals.")
+propose_failed = Counter(
+    "etcd_server_proposal_failed_total",
+    "The total number of failed proposals.")
+file_descriptors_used = Gauge(
+    "etcd_server_file_descriptors_used_total",
+    "The total number of file descriptors used.")
+
+# wal/metrics.go
+wal_fsync_durations = Summary(
+    "etcd_wal_fsync_durations_microseconds",
+    "The latency distributions of fsync called by wal.")
+wal_last_index_saved = Gauge(
+    "etcd_wal_last_index_saved",
+    "The index of the last entry saved by wal.")
+
+# snap/metrics.go
+snap_save_durations = Summary(
+    "etcd_snapshot_save_total_durations_microseconds",
+    "The total latency distributions of save called by snapshot.")
+
+# rafthttp/metrics.go
+msg_sent_latency = LabeledSummary(
+    "etcd_rafthttp_message_sent_latency_microseconds",
+    "message sent latency distributions.",
+    ("sendingType", "remoteID", "msgType"))
+msg_sent_failed = LabeledCounter(
+    "etcd_rafthttp_message_sent_failed_total",
+    "The total number of failed messages sent.",
+    ("sendingType", "remoteID", "msgType"))
+
+
+def fd_usage() -> Tuple[int, int]:
+    """(used, limit) file descriptors (reference pkg/runtime/fds_linux.go)."""
+    import os
+    import resource
+    try:
+        used = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        used = -1
+    limit = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    return used, limit

@@ -20,13 +20,14 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 import zlib
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 from etcd_tpu import raftpb
 from etcd_tpu.raftpb import Entry, HardState, EMPTY_HARD_STATE
-from etcd_tpu.utils import fileutil
+from etcd_tpu.utils import fileutil, metrics
 
 # Record types (reference wal/wal.go:37-42).
 METADATA_TYPE = 1
@@ -414,8 +415,13 @@ class WAL:
             self.state = st
         self._enc.flush()
         if must_sync:
+            t0 = time.perf_counter()
             fileutil.fsync(self._tail.fileno())
+            metrics.wal_fsync_durations.observe(
+                (time.perf_counter() - t0) * 1e6)
             self.fsync_count += 1
+        if ents:
+            metrics.wal_last_index_saved.set(self.enti)
         if self._tail.tell() >= self.segment_size:
             self._cut()
 

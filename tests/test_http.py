@@ -335,6 +335,38 @@ class TestMeta:
         st, _, body = curl(cluster, "GET", "/health")
         assert st == 200 and body["health"] == "true"
 
+    def test_metrics_endpoint(self, cluster):
+        """Prometheus text format with the reference's metric families
+        (etcdserver/wal/snap/rafthttp metrics.go)."""
+        curl(cluster, "PUT", "/v2/keys/metric-poke", form({"value": "x"}),
+             FORM_HDR)
+        st, hd, body = curl(cluster, "GET", "/metrics")
+        assert st == 200
+        assert hd["Content-Type"].startswith("text/plain")
+        for family in ("etcd_server_proposal_durations_milliseconds",
+                       "etcd_server_pending_proposal_total",
+                       "etcd_server_proposal_failed_total",
+                       "etcd_server_file_descriptors_used_total",
+                       "etcd_wal_fsync_durations_microseconds",
+                       "etcd_wal_last_index_saved"):
+            assert f"# TYPE {family}" in body, family
+        # real observations flowed in: the proposal count is > 0
+        for line in body.splitlines():
+            if line.startswith(
+                    "etcd_server_proposal_durations_milliseconds_count"):
+                assert float(line.split()[-1]) > 0
+                break
+        else:
+            raise AssertionError("proposal count series missing")
+
+    def test_debug_vars(self, cluster):
+        st, _, body = curl(cluster, "GET", "/debug/vars")
+        assert st == 200
+        assert body["file_descriptor_limit"] > 0
+        rs = body["raft.status"]
+        assert rs["raftState"] in ("LEADER", "FOLLOWER", "CANDIDATE")
+        assert int(rs["lead"], 16) != 0
+
     def test_404_paths(self, cluster):
         st, _, _ = curl(cluster, "GET", "/v2/bogus")
         assert st == 404

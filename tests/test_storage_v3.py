@@ -1,0 +1,310 @@
+"""v3 MVCC storage tests, modeled on reference storage/{kvstore,key_index,
+index,backend}_test.go: revisioned puts, range-at-rev, tombstones, txn sub
+revisions, compaction keep-set semantics, backend batch commit, restore."""
+import struct
+import threading
+import time
+
+import pytest
+
+from etcd_tpu.storage import (Backend, CompactedError, KVStore, KeyIndex,
+                              Revision, RevisionNotFoundError, TreeIndex,
+                              TxnIDMismatchError, bytes_to_rev, rev_to_bytes)
+
+
+def test_revision_codec_orders():
+    a = rev_to_bytes(Revision(1, 0))
+    b = rev_to_bytes(Revision(1, 5))
+    c = rev_to_bytes(Revision(2, 0))
+    assert a < b < c
+    assert bytes_to_rev(b) == Revision(1, 5)
+
+
+# -- key index ----------------------------------------------------------------
+
+def test_key_index_generations():
+    ki = KeyIndex(b"foo")
+    ki.put(2, 0)
+    ki.put(4, 0)
+    ki.tombstone(6, 0)
+    ki.put(8, 0)
+
+    rev, created, ver = ki.get(4)
+    assert rev == Revision(4, 0) and created == Revision(2, 0) and ver == 2
+    rev, _, _ = ki.get(6)
+    assert rev == Revision(6, 0)  # the tombstone itself
+    rev, created, ver = ki.get(8)
+    assert rev == Revision(8, 0) and created == Revision(8, 0) and ver == 1
+    with pytest.raises(RevisionNotFoundError):
+        ki.get(1)  # before creation
+    assert ki.get(7)[0] == Revision(6, 0)
+
+
+def test_key_index_compact_drops_old_generations():
+    ki = KeyIndex(b"foo")
+    ki.put(2, 0)
+    ki.put(4, 0)
+    ki.tombstone(6, 0)
+    ki.put(8, 0)
+    avail = set()
+    ki.compact(7, avail)
+    # generation 1 fully ended before 7 → dropped entirely
+    with pytest.raises(RevisionNotFoundError):
+        ki.get(5)
+    assert ki.get(8)[0] == Revision(8, 0)
+
+
+def test_tree_index_range():
+    ti = TreeIndex()
+    for i, k in enumerate([b"a", b"b", b"c"]):
+        ti.put(k, Revision(i + 1, 0))
+    keys, revs = ti.range(b"a", b"c", at_rev=3)
+    assert keys == [b"a", b"b"]
+    keys, _ = ti.range(b"a", b"c", at_rev=1)
+    assert keys == [b"a"]  # b not yet written at rev 1
+    keys, _ = ti.range(b"b", None, at_rev=3)
+    assert keys == [b"b"]
+
+
+# -- backend ------------------------------------------------------------------
+
+def test_backend_put_range_delete(tmp_path):
+    b = Backend(str(tmp_path / "db"), batch_interval=3600)
+    try:
+        with b.batch_tx as tx:
+            tx.unsafe_create_bucket(b"key")
+            for i in range(5):
+                tx.unsafe_put(b"key", bytes([i]), f"v{i}".encode())
+            keys, vals = tx.unsafe_range(b"key", bytes([1]), bytes([4]))
+            assert [k[0] for k in keys] == [1, 2, 3]
+            keys, vals = tx.unsafe_range(b"key", bytes([2]))
+            assert vals == [b"v2"]
+            tx.unsafe_delete(b"key", bytes([2]))
+            keys, _ = tx.unsafe_range(b"key", bytes([2]))
+            assert keys == []
+    finally:
+        b.close()
+
+
+def test_backend_batch_limit_commits(tmp_path):
+    import sqlite3
+    path = str(tmp_path / "db")
+    b = Backend(path, batch_interval=3600, batch_limit=3)
+    try:
+        with b.batch_tx as tx:
+            tx.unsafe_create_bucket(b"key")
+        b.force_commit()
+        with b.batch_tx as tx:
+            for i in range(5):  # crosses the batch limit → auto commit
+                tx.unsafe_put(b"key", bytes([i]), b"x")
+        other = sqlite3.connect(path)
+        n = other.execute("SELECT COUNT(*) FROM bucket_key").fetchone()[0]
+        other.close()
+        assert n >= 4  # the first 4 were committed by the limit trigger
+    finally:
+        b.close()
+
+
+# -- kvstore ------------------------------------------------------------------
+
+@pytest.fixture
+def kv(tmp_path):
+    s = KVStore(str(tmp_path / "kv.db"), batch_interval=3600,
+                compaction_pause=0.0)
+    yield s
+    s.close()
+
+
+def test_put_range_revisions(kv):
+    assert kv.put(b"foo", b"bar") == 1
+    assert kv.put(b"foo", b"bar2") == 2
+    assert kv.put(b"baz", b"qux") == 3
+
+    kvs, rev = kv.range(b"foo")
+    assert rev == 3
+    assert kvs[0].value == b"bar2"
+    assert kvs[0].create_rev == 1 and kvs[0].mod_rev == 2
+    assert kvs[0].version == 2
+
+    # range at an old revision sees history
+    kvs, rev = kv.range(b"foo", range_rev=1)
+    assert kvs[0].value == b"bar" and rev == 1
+    # range over [baz, fop) at rev 3
+    kvs, _ = kv.range(b"baz", b"fop")
+    assert [k.key for k in kvs] == [b"baz", b"foo"]
+    # limit
+    kvs, _ = kv.range(b"baz", b"fop", limit=1)
+    assert [k.key for k in kvs] == [b"baz"]
+
+
+def test_delete_range_tombstones(kv):
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    n, rev = kv.delete_range(b"a", b"c")
+    assert n == 2 and rev == 3
+    kvs, _ = kv.range(b"a", b"c")
+    assert kvs == []
+    # history still visible before the tombstone
+    kvs, _ = kv.range(b"a", b"c", range_rev=2)
+    assert len(kvs) == 2
+    # delete of missing key is a no-op
+    n, _ = kv.delete_range(b"nope")
+    assert n == 0
+
+
+def test_txn_sub_revisions(kv):
+    tid = kv.txn_begin()
+    assert kv.txn_put(tid, b"k1", b"v1") == 1
+    assert kv.txn_put(tid, b"k2", b"v2") == 1
+    kvs, _ = kv.txn_range(tid, b"k1")
+    assert kvs[0].value == b"v1"
+    kv.txn_end(tid)
+    # both ops share main revision 1 with distinct subs
+    kvs, rev = kv.range(b"k1", b"k3")
+    assert rev == 1 and len(kvs) == 2
+
+    with pytest.raises(TxnIDMismatchError):
+        kv.txn_put(12345, b"x", b"y")
+
+    tid = kv.txn_begin()
+    kv.txn_end(tid)  # empty txn consumes no revision
+    _, rev = kv.range(b"k1")
+    assert rev == 1
+
+
+def test_compaction(kv):
+    for i in range(5):
+        kv.put(b"foo", f"v{i}".encode())  # revs 1..5
+    kv.put(b"other", b"x")                # rev 6
+    t = kv.compact(4)
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+    # reads at ≤ the compacted revision fail (reference kvstore.go:172)
+    with pytest.raises(CompactedError):
+        kv.range(b"foo", range_rev=3)
+    with pytest.raises(CompactedError):
+        kv.range(b"foo", range_rev=4)
+    with pytest.raises(CompactedError):
+        kv.compact(3)
+    # reads above the boundary still work off the kept revision
+    kvs, _ = kv.range(b"foo", range_rev=5)
+    assert kvs[0].value == b"v4"
+    kvs, _ = kv.range(b"foo")
+    assert kvs[0].value == b"v4"
+
+
+def test_compaction_scrubs_backend(kv):
+    for i in range(10):
+        kv.put(b"k", str(i).encode())
+    kv.compact(9).join(timeout=10)
+    kv.b.force_commit()
+    with kv.b.batch_tx as tx:
+        keys, _ = tx.unsafe_range(b"key", bytes(17),
+                                  struct.pack(">Q", 2**62) + b"_" + bytes(8))
+    revkeys = [k for k in keys if len(k) == 17]
+    # only the keep-revision (9) and the live rev 10 remain
+    assert len(revkeys) == 2
+
+
+def test_restore_after_reopen(tmp_path):
+    path = str(tmp_path / "kv.db")
+    s = KVStore(path, batch_interval=3600)
+    s.put(b"a", b"1")
+    s.put(b"b", b"2")
+    s.put(b"a", b"3")
+    s.delete_range(b"b")
+    s.b.force_commit()
+    s.close()
+
+    s2 = KVStore(path, batch_interval=3600)
+    try:
+        kvs, rev = s2.range(b"a")
+        assert rev == 4 and kvs[0].value == b"3"
+        assert kvs[0].create_rev == 1 and kvs[0].version == 2
+        kvs, _ = s2.range(b"b")
+        assert kvs == []
+        # history survived too
+        kvs, _ = s2.range(b"b", range_rev=2)
+        assert kvs[0].value == b"2"
+        # new writes continue the revision sequence
+        assert s2.put(b"c", b"x") == 5
+    finally:
+        s2.close()
+
+
+def test_restore_after_compaction(tmp_path):
+    path = str(tmp_path / "kv.db")
+    s = KVStore(path, batch_interval=3600, compaction_pause=0.0)
+    for i in range(5):
+        s.put(b"k", str(i).encode())
+    s.compact(4).join(timeout=10)
+    s.b.force_commit()
+    s.close()
+
+    s2 = KVStore(path, batch_interval=3600)
+    try:
+        assert s2.compact_main_rev == 4
+        with pytest.raises(CompactedError):
+            s2.range(b"k", range_rev=2)
+        kvs, rev = s2.range(b"k")
+        assert rev == 5 and kvs[0].value == b"4"
+        assert s2.put(b"k2", b"y") == 6
+    finally:
+        s2.close()
+
+
+def test_version_metadata_survives_compaction(kv):
+    """create_rev/version must reflect the key's full history even after
+    compaction truncates the generation's revision list."""
+    for i in range(5):
+        kv.put(b"foo", f"v{i}".encode())  # revs 1..5, versions 1..5
+    kv.compact(4).join(timeout=10)
+    rev = kv.put(b"foo", b"v5")           # rev 6, version 6
+    kvs, _ = kv.range(b"foo")
+    assert kvs[0].create_rev == 1
+    assert kvs[0].version == 6
+    assert kvs[0].mod_rev == rev
+
+
+def test_crash_mid_scrub_resumes_compaction(tmp_path):
+    """A compaction whose scrub died before the finished marker must be
+    resumed (and its boundary enforced) on reopen."""
+    path = str(tmp_path / "kv.db")
+    s = KVStore(path, batch_interval=3600, compaction_pause=0.0)
+    for i in range(10):
+        s.put(b"k", str(i).encode())
+    # simulate crash-after-schedule: write the schedule marker + index
+    # compaction, but never run the scrub
+    with s._mu:
+        s.compact_main_rev = 9
+        with s.b.batch_tx as tx:
+            tx.unsafe_put(b"key", b"scheduledCompactRev",
+                          rev_to_bytes(Revision(9, 0)))
+        s.kvindex.compact(9)
+    s.b.force_commit()
+    s.close()
+
+    s2 = KVStore(path, batch_interval=3600, compaction_pause=0.0)
+    try:
+        assert s2.compact_main_rev == 9
+        with pytest.raises(CompactedError):
+            s2.range(b"k", range_rev=5)
+        kvs, rev = s2.range(b"k")
+        assert rev == 10 and kvs[0].value == b"9"
+        # the resumed scrub actually removes pre-boundary records
+        import time as _t
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            s2.b.force_commit()
+            with s2.b.batch_tx as tx:
+                keys, _ = tx.unsafe_range(
+                    b"key", bytes(17),
+                    struct.pack(">Q", 2**62) + b"_" + bytes(8))
+            revkeys = [k for k in keys if len(k) == 17]
+            if len(revkeys) == 2:
+                break
+            _t.sleep(0.05)
+        assert len(revkeys) == 2, f"scrub not resumed: {len(revkeys)} left"
+    finally:
+        s2.close()
