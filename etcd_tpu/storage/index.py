@@ -86,6 +86,19 @@ class KeyIndex:
         version = g.ver - (len(g.revs) - 1 - n)
         return g.revs[n], g.created or g.revs[0], version
 
+    def live_meta(self) -> Optional[Tuple[Revision, int]]:
+        """(created, version) of the OPEN generation — i.e. the key as it
+        exists now, including same-transaction puts; None when the key is
+        absent or its latest generation was closed by a tombstone. This is
+        what a put must consult for create_rev/version: a key re-created
+        after a delete starts a fresh generation at version 1."""
+        if not self.generations:
+            return None
+        g = self.generations[-1]
+        if g.empty or g.created is None:
+            return None
+        return g.created, g.ver
+
     @property
     def empty(self) -> bool:
         return (len(self.generations) == 0 or
@@ -156,6 +169,13 @@ class TreeIndex:
             if ki is None:
                 raise RevisionNotFoundError(key)
             return ki.get(at_rev)
+
+    def live_meta(self, key: bytes) -> Optional[Tuple[Revision, int]]:
+        with self._lock:
+            ki = self._map.get(key)
+            if ki is None:
+                return None
+            return ki.live_meta()
 
     def range(self, key: bytes, end: Optional[bytes], at_rev: int
               ) -> Tuple[List[bytes], List[Revision]]:

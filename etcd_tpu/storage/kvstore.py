@@ -90,6 +90,7 @@ class KVStore:
         self._txn_counter = 0
         self.compaction_batch = compaction_batch
         self.compaction_pause = compaction_pause
+        self._compact_threads = []
 
         with self.b.batch_tx as tx:
             tx.unsafe_create_bucket(KEY_BUCKET)
@@ -101,8 +102,10 @@ class KVStore:
 
     def put(self, key: bytes, value: bytes) -> int:
         tid = self.txn_begin()
-        self._put(key, value, self.current_rev.main + 1)
-        self.txn_end(tid)
+        try:
+            self._put(key, value, self.current_rev.main + 1)
+        finally:
+            self.txn_end(tid)
         return self.current_rev.main
 
     def range(self, key: bytes, end: Optional[bytes] = None, limit: int = 0,
@@ -116,8 +119,10 @@ class KVStore:
     def delete_range(self, key: bytes, end: Optional[bytes] = None
                      ) -> Tuple[int, int]:
         tid = self.txn_begin()
-        n = self._delete_range(key, end, self.current_rev.main + 1)
-        self.txn_end(tid)
+        try:
+            n = self._delete_range(key, end, self.current_rev.main + 1)
+        finally:
+            self.txn_end(tid)
         return n, self.current_rev.main
 
     # -- txn API (reference kvstore.go:81-139) ------------------------------
@@ -176,42 +181,52 @@ class KVStore:
                 raise ValueError(f"revision {rev} is in the future")
             self.compact_main_rev = rev
             with self.b.batch_tx as tx:
-                tx.unsafe_put(KEY_BUCKET, SCHEDULED_COMPACT_KEY,
+                tx.unsafe_put(META_BUCKET, SCHEDULED_COMPACT_KEY,
                               rev_to_bytes(Revision(rev, 0)))
             keep = self.kvindex.compact(rev)
         t = threading.Thread(target=self._scheduled_compaction,
                              args=(rev, keep), daemon=True,
                              name="storage-compact")
+        self._compact_threads.append(t)
         t.start()
         return t
 
     def _scheduled_compaction(self, compact_rev: int, keep) -> None:
         """Scrub backend revisions ≤ compact_rev not in `keep`, in paced
         batches (reference kvstore_compaction.go:8-41)."""
+        import sqlite3
         end = struct.pack(">Q", compact_rev + 1)
         last = bytes(17)
         while True:
-            with self.b.batch_tx as tx:
-                keys, _ = tx.unsafe_range(KEY_BUCKET, last, end,
-                                          self.compaction_batch)
-                rev = None
-                for kb in keys:
-                    if len(kb) != 17:
-                        continue  # meta keys living in the bucket
-                    rev = bytes_to_rev(kb)
-                    if rev not in keep:
-                        tx.unsafe_delete(KEY_BUCKET, kb)
-                if not keys:
-                    tx.unsafe_put(KEY_BUCKET, FINISHED_COMPACT_KEY,
-                                  rev_to_bytes(Revision(compact_rev, 0)))
-                    log.info("storage: finished compaction at %d",
-                             compact_rev)
-                    return
-                if rev is not None:
-                    last = rev_to_bytes(Revision(rev.main, rev.sub + 1))
-                else:
-                    return
+            try:
+                finished, last = self._compaction_step(compact_rev, keep,
+                                                       end, last)
+            except sqlite3.ProgrammingError:
+                return  # backend closed; restore() resumes next open
+            if finished:
+                return
             time.sleep(self.compaction_pause)
+
+    def _compaction_step(self, compact_rev, keep, end, last):
+        """One scrub batch; returns (finished, next_last)."""
+        with self.b.batch_tx as tx:
+            keys, _ = tx.unsafe_range(KEY_BUCKET, last, end,
+                                      self.compaction_batch)
+            rev = None
+            for kb in keys:
+                if len(kb) != 17:
+                    continue
+                rev = bytes_to_rev(kb)
+                if rev not in keep:
+                    tx.unsafe_delete(KEY_BUCKET, kb)
+            if not keys:
+                tx.unsafe_put(META_BUCKET, FINISHED_COMPACT_KEY,
+                              rev_to_bytes(Revision(compact_rev, 0)))
+                log.info("storage: finished compaction at %d", compact_rev)
+                return True, last
+            if rev is None:
+                return True, last
+            return False, rev_to_bytes(Revision(rev.main, rev.sub + 1))
 
     # -- internals ----------------------------------------------------------
 
@@ -245,11 +260,15 @@ class KVStore:
 
     def _put(self, key: bytes, value: bytes, rev: int) -> None:
         sub = self.current_rev.sub
-        try:
-            _, created, ver = self.kvindex.get(key, rev - 1)
+        # Metadata comes from the OPEN generation so that (a) a second put
+        # of the same key inside one txn sees the first (same main rev), and
+        # (b) a put after a tombstone restarts at version 1.
+        meta = self.kvindex.live_meta(key)
+        if meta is not None:
+            created, ver = meta
             create_rev = created.main
             version = ver + 1
-        except RevisionNotFoundError:
+        else:
             create_rev = rev
             version = 1
         kv = KeyValue(key, value, create_rev, rev, version)
@@ -297,10 +316,10 @@ class KVStore:
         with self._mu:
             scheduled = -1
             with self.b.batch_tx as tx:
-                _, vs = tx.unsafe_range(KEY_BUCKET, FINISHED_COMPACT_KEY)
+                _, vs = tx.unsafe_range(META_BUCKET, FINISHED_COMPACT_KEY)
                 if vs:
                     self.compact_main_rev = bytes_to_rev(vs[0]).main
-                _, vs = tx.unsafe_range(KEY_BUCKET, SCHEDULED_COMPACT_KEY)
+                _, vs = tx.unsafe_range(META_BUCKET, SCHEDULED_COMPACT_KEY)
                 if vs:
                     scheduled = bytes_to_rev(vs[0]).main
                 keys, vals = tx.unsafe_range(
@@ -342,9 +361,15 @@ class KVStore:
                          scheduled)
                 self.compact_main_rev = scheduled
                 keep = self.kvindex.compact(scheduled)
-                threading.Thread(target=self._scheduled_compaction,
-                                 args=(scheduled, keep), daemon=True,
-                                 name="storage-compact-resume").start()
+                t = threading.Thread(target=self._scheduled_compaction,
+                                     args=(scheduled, keep), daemon=True,
+                                     name="storage-compact-resume")
+                self._compact_threads.append(t)
+                t.start()
 
     def close(self) -> None:
+        # Let in-flight scrubs finish before the backend goes away; an
+        # unfinished scrub is resumed on the next open either way.
+        for t in self._compact_threads:
+            t.join(timeout=10)
         self.b.close()

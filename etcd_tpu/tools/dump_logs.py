@@ -1,0 +1,103 @@
+"""Offline data-dir inspector.
+
+Behavioral equivalent of reference tools/etcd-dump-logs: load the newest
+snapshot (print its term/index/conf state), then replay the WAL from the
+snapshot marker and print every entry — decoded Requests for normal
+entries, decoded ConfChanges for configuration entries — plus the WAL
+metadata (node/cluster IDs) and final HardState.
+
+Usage: python -m etcd_tpu.tools.dump_logs <data-dir>
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional, Sequence
+
+from etcd_tpu import raftpb
+from etcd_tpu.raftpb import ConfChangeType, EntryType
+from etcd_tpu.server.request import Request
+from etcd_tpu.snap import Snapshotter
+from etcd_tpu.wal import WAL, WalSnapshot
+
+
+def _describe_entry(e) -> str:
+    if e.type == EntryType.CONF_CHANGE:
+        cc = raftpb.decode_conf_change(e.data)
+        kind = ConfChangeType(cc.type).name
+        ctx = ""
+        if cc.context:
+            try:
+                ctx = " " + json.dumps(json.loads(cc.context.decode()))
+            except (ValueError, UnicodeDecodeError):
+                ctx = f" <{len(cc.context)}B context>"
+        return (f"{e.term}\t{e.index}\tconf\t{kind} "
+                f"{cc.node_id:x}{ctx}")
+    if not e.data:
+        return f"{e.term}\t{e.index}\tnorm\t<empty>"
+    try:
+        r = Request.decode(e.data)
+        detail = f"{r.method} {r.path}"
+        if r.val:
+            v = r.val if len(r.val) <= 32 else r.val[:29] + "..."
+            detail += f" val={v!r}"
+        if r.prev_exist is not None:
+            detail += f" prevExist={r.prev_exist}"
+        return f"{e.term}\t{e.index}\tnorm\t{detail}"
+    except Exception:
+        return f"{e.term}\t{e.index}\tnorm\t<{len(e.data)}B undecodable>"
+
+
+def dump(data_dir: str, out=sys.stdout) -> int:
+    import os
+    snapdir = os.path.join(data_dir, "member", "snap")
+    waldir = os.path.join(data_dir, "member", "wal")
+    if not os.path.isdir(waldir):
+        print(f"no member/wal under {data_dir}", file=sys.stderr)
+        return 1
+
+    walsnap = WalSnapshot()
+    if os.path.isdir(snapdir):
+        snap = Snapshotter(snapdir).load_or_none()
+        if snap is not None:
+            md = snap.metadata
+            walsnap = WalSnapshot(index=md.index, term=md.term)
+            print(f"Snapshot:\nterm={md.term} index={md.index} nodes="
+                  f"{[f'{n:x}' for n in md.conf_state.nodes]}", file=out)
+        else:
+            print("Snapshot:\nempty", file=out)
+
+    print("Start dumping log entries from snapshot.", file=out)
+    w = WAL.open(waldir, walsnap, write=False)
+    try:
+        metadata, state, ents = w.read_all()
+    finally:
+        w.close()
+    try:
+        md = json.loads(metadata.decode())
+        print(f"WAL metadata:\nnodeID={md['id']} clusterID="
+              f"{md['clusterId']}", file=out)
+    except (ValueError, KeyError):
+        print(f"WAL metadata: <{len(metadata)}B>", file=out)
+    print(f"WAL entries: {len(ents)}", file=out)
+    if ents:
+        print(f"lastIndex={ents[-1].index}", file=out)
+    print("term\tindex\ttype\tdata", file=out)
+    for e in ents:
+        print(_describe_entry(e), file=out)
+    print(f"HardState: term={state.term} vote={state.vote:x} "
+          f"commit={state.commit}", file=out)
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 1:
+        print("usage: python -m etcd_tpu.tools.dump_logs <data-dir>",
+              file=sys.stderr)
+        return 2
+    return dump(argv[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,3 +23,7 @@ import jax  # noqa: E402  (preloaded anyway — see module docstring)
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running chaos/e2e test")
