@@ -104,17 +104,27 @@ class KeyIndex:
         return (len(self.generations) == 0 or
                 (len(self.generations) == 1 and self.generations[0].empty))
 
-    def _find_generation(self, rev: int) -> Optional[Generation]:
-        for g in reversed(self.generations):
+    def _find_generation(self, rev: int,
+                         include_dead: bool = False) -> Optional[Generation]:
+        """Generation alive at `rev` (reference key_index.go findGeneration):
+        a non-last generation whose tombstone ≤ rev means the key is DEAD at
+        rev — reads must not surface it. `include_dead` keeps the old raw
+        walk for compact(), which must still locate dead generations in
+        order to drop them."""
+        last = len(self.generations) - 1
+        for i in range(last, -1, -1):
+            g = self.generations[i]
             if g.empty:
                 continue
+            if not include_dead and i != last and g.revs[-1].main <= rev:
+                return None
             if g.revs[0].main <= rev:
                 return g
         return None
 
     def compact(self, at_rev: int, available: Set[Revision]) -> None:
         """Drop revisions ≤ at_rev (reference key_index.go compact)."""
-        g = self._find_generation(at_rev)
+        g = self._find_generation(at_rev, include_dead=True)
         if g is None:
             return
         gi = self.generations.index(g)

@@ -295,6 +295,9 @@ class KVStore:
         if self.current_rev.sub > 0:
             grev += 1
         try:
+            # Dead keys (tombstone ≤ grev) never surface from the index
+            # (reference key_index.go findGeneration), so a double delete
+            # lands here and is a no-op.
             self.kvindex.get(key, grev)
         except RevisionNotFoundError:
             return False

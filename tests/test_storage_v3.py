@@ -7,6 +7,7 @@ import time
 
 import pytest
 
+from etcd_tpu.storage import kvstore
 from etcd_tpu.storage import (Backend, CompactedError, KVStore, KeyIndex,
                               Revision, RevisionNotFoundError, TreeIndex,
                               TxnIDMismatchError, bytes_to_rev, rev_to_bytes)
@@ -31,13 +32,18 @@ def test_key_index_generations():
 
     rev, created, ver = ki.get(4)
     assert rev == Revision(4, 0) and created == Revision(2, 0) and ver == 2
-    rev, _, _ = ki.get(6)
-    assert rev == Revision(6, 0)  # the tombstone itself
+    rev, _, _ = ki.get(5)
+    assert rev == Revision(4, 0)  # last rev <= 5 in the live generation
+    # at/after the tombstone the key is DEAD until recreated (reference
+    # key_index.go findGeneration: non-last generation w/ tomb <= rev -> nil)
+    with pytest.raises(RevisionNotFoundError):
+        ki.get(6)
+    with pytest.raises(RevisionNotFoundError):
+        ki.get(7)
     rev, created, ver = ki.get(8)
     assert rev == Revision(8, 0) and created == Revision(8, 0) and ver == 1
     with pytest.raises(RevisionNotFoundError):
         ki.get(1)  # before creation
-    assert ki.get(7)[0] == Revision(6, 0)
 
 
 def test_key_index_compact_drops_old_generations():
@@ -150,6 +156,34 @@ def test_delete_range_tombstones(kv):
     # delete of missing key is a no-op
     n, _ = kv.delete_range(b"nope")
     assert n == 0
+
+
+def test_delete_already_deleted_is_noop(kv):
+    """Re-deleting a tombstoned key must not bump the revision or write a
+    second tombstone (reference kvstore.go delete checks the event type at
+    the index hit)."""
+    kv.put(b"x", b"1")          # rev 1
+    n, rev = kv.delete_range(b"x")
+    assert n == 1 and rev == 2
+    n, rev2 = kv.delete_range(b"x")
+    assert n == 0
+    assert kv.current_rev.main == 2  # no spurious revision bump
+    # index has exactly one closed generation, no degenerate tombstone-only one
+    ki = kv.kvindex._map.get(b"x")
+    assert ki is not None
+    live = [g for g in ki.generations if not g.empty]
+    assert len(live) == 1 and len(live[0].revs) == 2
+
+
+def test_range_limit_skips_tombstoned_keys(kv):
+    """A dead key must not consume a limit slot: the index never surfaces
+    keys whose tombstone <= rev (reference key_index.go findGeneration)."""
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    kv.put(b"c", b"3")
+    kv.delete_range(b"a")
+    kvs, _ = kv.range(b"a", b"z", limit=2)
+    assert [k.key for k in kvs] == [b"b", b"c"]
 
 
 def test_txn_sub_revisions(kv):
@@ -279,7 +313,7 @@ def test_crash_mid_scrub_resumes_compaction(tmp_path):
     with s._mu:
         s.compact_main_rev = 9
         with s.b.batch_tx as tx:
-            tx.unsafe_put(b"key", b"scheduledCompactRev",
+            tx.unsafe_put(kvstore.META_BUCKET, kvstore.SCHEDULED_COMPACT_KEY,
                           rev_to_bytes(Revision(9, 0)))
         s.kvindex.compact(9)
     s.b.force_commit()
