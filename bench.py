@@ -1,9 +1,10 @@
 """Headline benchmark: aggregate Raft commits/sec across G groups on one chip.
 
-Reproduces BASELINE.json config 4's shape (default 100k groups x 5 peers,
-uniform writes) with the batched consensus kernel: every round is ONE XLA
-program stepping all G x P instances (tick + message delivery + proposals +
-quorum commit + send assembly), with message routing a device-side transpose.
+Reproduces BASELINE.json config 4's shape (default 100k groups x 5 peers on
+TPU, auto-scaled down on CPU fallback) with the batched consensus kernel:
+every round is ONE XLA program stepping all G x P instances (tick + message
+delivery + proposals + quorum commit + send assembly), with message routing a
+device-side transpose.
 
 Baseline for vs_baseline: the reference's best published write throughput,
 4,157 writes/sec (256B values, 256 clients, leader-only — BASELINE.md,
@@ -11,26 +12,48 @@ Documentation/benchmarks/etcd-2-1-0-benchmarks.md:46). One committed entry
 here == one write there (payloads ride the host log store; the device commits
 index metadata, which is the consensus bottleneck being measured).
 
-Env knobs: BENCH_GROUPS (default 100000), BENCH_PEERS (5), BENCH_ROUNDS
-(200 measured), BENCH_WARM_ROUNDS. Prints ONE JSON line on stdout.
+Latency is MEASURED, not estimated: per-round history of the leader's
+last_index (admission time) and commit (commit time) gives per-proposal
+propose->commit latency; p50/p99 are computed over sampled groups.
+
+Robustness contract with the driver: this process ALWAYS prints exactly one
+JSON line on stdout and exits 0, within BENCH_BUDGET_S wall seconds. The
+actual measurement runs in a child process; if the child hangs (e.g. the
+ambient axon TPU tunnel blocks backend init — round 1's failure mode) the
+parent kills it, retries on forced CPU, and as a last resort emits an error
+JSON line itself.
+
+Env knobs: BENCH_GROUPS, BENCH_PEERS (5), BENCH_ROUNDS, BENCH_WARM_ROUNDS,
+BENCH_BUDGET_S (200), BENCH_SCENARIO (uniform|lag), BENCH_PLATFORM.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
+
+BASELINE_WRITES_PER_SEC = 4157.0
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main() -> int:
-    G = int(os.environ.get("BENCH_GROUPS", 100_000))
-    P = int(os.environ.get("BENCH_PEERS", 5))
-    rounds = int(os.environ.get("BENCH_ROUNDS", 200))
-    warm = int(os.environ.get("BENCH_WARM_ROUNDS", 30))
+# ---------------------------------------------------------------------------
+# Child: the actual measurement
+# ---------------------------------------------------------------------------
+
+def child_main() -> int:
+    budget = float(os.environ.get("BENCH_BUDGET_S", 200.0))
+    deadline = time.time() + budget * 0.9
+    platform = os.environ.get("BENCH_PLATFORM", "auto")
+    scenario = os.environ.get("BENCH_SCENARIO", "uniform")
+
+    if platform == "cpu":
+        from etcd_tpu.utils.platform import force_cpu
+        force_cpu(1)
 
     import jax
     import jax.numpy as jnp
@@ -42,84 +65,225 @@ def main() -> int:
         log(f"primary backend unavailable ({e}); falling back to CPU")
         jax.config.update("jax_platforms", "cpu")
         devs = jax.devices()
-    log(f"devices: {devs}")
+    on_tpu = devs[0].platform == "tpu"
+    log(f"devices: {devs} (tpu={on_tpu})")
 
     from etcd_tpu.ops import kernel
     from etcd_tpu.ops.state import LEADER, KernelConfig, init_state
 
+    G = int(os.environ.get("BENCH_GROUPS", 100_000 if on_tpu else 8_192))
+    P = int(os.environ.get("BENCH_PEERS", 5))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 300 if on_tpu else 60))
+    warm = int(os.environ.get("BENCH_WARM_ROUNDS", 20 if on_tpu else 5))
+
     cfg = KernelConfig(groups=G, peers=P, window=16, max_ents=4,
                        election_tick=10, heartbeat_tick=3)
-    st = init_state(cfg)
+    st = init_state(cfg, stagger=True)
     inbox = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
     zero = jnp.zeros(G, jnp.int32)
 
-    # --- Phase 1: elect every group's leader -----------------------------
+    # --- Phase 1: staggered elections converge in 3 rounds ----------------
     t0 = time.time()
-    for r in range(2000):
+    for r in range(8):
         st, outbox = kernel.step(cfg, st, inbox, zero, zero,
                                  jnp.asarray(True))
         inbox = kernel.route_local(outbox)
-        if r % 25 == 24:
-            state = np.asarray(st.state)
-            missing = int((np.sum(state == LEADER, axis=1) == 0).sum())
-            log(f"round {r + 1}: {G - missing}/{G} groups have leaders")
-            if missing == 0:
-                break
+        state = np.asarray(st.state)
+        if (np.sum(state == LEADER, axis=1) >= 1).all():
+            break
     state = np.asarray(st.state)
-    if (np.sum(state == LEADER, axis=1) == 0).any():
-        log("FATAL: elections did not converge")
-        return 1
-    log(f"elections converged in {time.time() - t0:.1f}s")
+    if not (np.sum(state == LEADER, axis=1) >= 1).all():
+        raise RuntimeError("staggered elections did not converge in 8 rounds")
+    log(f"elections converged in {r + 1} rounds ({time.time() - t0:.1f}s "
+        f"incl compile)")
 
     slots = jnp.asarray((state == LEADER).argmax(axis=1).astype(np.int32))
     full = jnp.full(G, cfg.max_ents, jnp.int32)
 
-    def commits_now(st):
-        c = np.asarray(st.commit)
-        s = np.asarray(slots)
-        return int(c[np.arange(G), s].sum())
+    # Optional scenario: pause 1 follower in 5% of groups (BASELINE config 4
+    # lagging-follower injection). The paused instance receives nothing, so
+    # it never acks; leader-side flow control must engage.
+    drop = None
+    lagged = 0
+    if scenario == "lag":
+        rng = np.random.default_rng(0)
+        lag_groups = rng.choice(G, size=max(1, G // 20), replace=False)
+        # Pause = full partition of one non-leader slot: zero messages both
+        # TO it (inbox[g, to, frm]: to axis) and FROM it (frm axis). Inbound
+        # -only dropping would let the paused slot campaign at ever-higher
+        # terms and depose the leader — churn, not flow control. Leader-side
+        # behavior under this: flow pause engages at window//2 unacked
+        # entries (effective_flow_window), then once the ring moves past the
+        # follower's next the group flags need_host (snapshot; serviced by
+        # the host engine, not this pure-device bench).
+        mask_to = np.ones((G, P, 1, 1), np.int32)
+        mask_from = np.ones((G, 1, P, 1), np.int32)
+        lag_slot = (np.asarray(slots)[lag_groups] + 1) % P
+        mask_to[lag_groups, lag_slot] = 0
+        mask_from[lag_groups, 0, lag_slot] = 0
+        drop = jnp.asarray(mask_to * mask_from)
+        lagged = len(lag_groups)
+        log(f"scenario=lag: partitioned 1 follower in {lagged} groups")
 
-    # --- Phase 2: steady-state proposal load -----------------------------
-    for _ in range(warm):
+    @jax.jit
+    def extract(st, slots):
+        g = jnp.arange(st.term.shape[0])
+        return st.last_index[g, slots], st.commit[g, slots]
+
+    def one_round(st, inbox):
         st, outbox = kernel.step(cfg, st, inbox, full, slots,
                                  jnp.asarray(True))
         inbox = kernel.route_local(outbox)
+        if drop is not None:
+            inbox = inbox * drop
+        return st, inbox
+
+    # --- Phase 2: warmup --------------------------------------------------
+    for _ in range(warm):
+        st, inbox = one_round(st, inbox)
     jax.block_until_ready(st.commit)
 
-    start_commits = commits_now(st)
-    times = []
-    t0 = time.time()
-    for r in range(rounds):
-        t_r = time.time()
-        st, outbox = kernel.step(cfg, st, inbox, full, slots,
-                                 jnp.asarray(True))
-        inbox = kernel.route_local(outbox)
-        jax.block_until_ready(inbox)
-        times.append(time.time() - t_r)
-    elapsed = time.time() - t0
-    end_commits = commits_now(st)
+    # Estimate round cost, adapt round count to the remaining budget.
+    t_est = time.time()
+    for _ in range(3):
+        st, inbox = one_round(st, inbox)
+    jax.block_until_ready(st.commit)
+    est = (time.time() - t_est) / 3
+    avail = deadline - time.time() - 5.0
+    rounds = max(10, min(rounds, int(avail / max(est, 1e-4))))
+    log(f"round cost ~{est * 1000:.2f} ms -> measuring {rounds} rounds")
 
-    commits = end_commits - start_commits
+    # --- Phase 3: measured steady-state load ------------------------------
+    li0, ci0 = extract(st, slots)           # baseline BEFORE measured round 0
+    jax.block_until_ready(ci0)
+    li_hist, ci_hist = [], []
+    t_hist = np.zeros(rounds + 1)
+    t_hist[0] = time.time()
+    for r in range(rounds):
+        st, inbox = one_round(st, inbox)
+        li, ci = extract(st, slots)
+        li_hist.append(li)
+        ci_hist.append(ci)
+        jax.block_until_ready(ci)
+        t_hist[r + 1] = time.time()
+    elapsed = t_hist[rounds] - t_hist[0]
+
+    li_h = np.asarray(jnp.stack(li_hist))   # (rounds, G) leader last_index
+    ci_h = np.asarray(jnp.stack(ci_hist))   # (rounds, G) leader commit
+    li0, ci0 = np.asarray(li0), np.asarray(ci0)
+
+    commits = int((ci_h[-1] - ci0).sum())
     cps = commits / elapsed
     round_ms = 1000.0 * elapsed / rounds
-    p99_round = 1000.0 * float(np.percentile(times, 99))
-    # A proposal needs one round to replicate (APP out) and one to ack
-    # (APP_RESP back + quorum commit): commit latency ~= 2 rounds.
-    p99_commit_ms = 2.0 * p99_round
 
-    log(f"G={G} P={P}: {commits} commits in {elapsed:.2f}s over {rounds} "
-        f"rounds ({round_ms:.2f} ms/round, p99 {p99_round:.2f} ms) -> "
-        f"{cps:,.0f} commits/s, est p99 commit latency {p99_commit_ms:.2f} ms")
+    # --- Measured propose->commit latency over sampled groups -------------
+    # Entry i is ADMITTED in the first round r with last_index >= i (the
+    # host handed it to the device at t_hist[r], i.e. before that round),
+    # and COMMITTED at the first round rc with commit >= i (visible at
+    # t_hist[rc+1]). Proposals not committed by the end are censored out
+    # (only the last ~2 rounds' worth).
+    rng = np.random.default_rng(1)
+    sample = rng.choice(G, size=min(G, 1024), replace=False)
+    lats = []
+    for g in sample:
+        li, ci = li_h[:, g], ci_h[:, g]
+        first, last = li0[g] + 1, ci[-1]
+        if last < first:
+            continue
+        idx = np.arange(first, last + 1)
+        r_adm = np.searchsorted(li, idx, side="left")
+        r_com = np.searchsorted(ci, idx, side="left")
+        lats.append(t_hist[r_com + 1] - t_hist[r_adm])
+    if lats:
+        lat = np.concatenate(lats)
+        p50_ms = round(1000.0 * float(np.percentile(lat, 50)), 3)
+        p99_ms = round(1000.0 * float(np.percentile(lat, 99)), 3)
+        n_lat = int(lat.size)
+    else:  # degenerate run: no sampled proposal committed in the window
+        p50_ms = p99_ms = None
+        n_lat = 0
 
-    baseline = 4157.0
-    print(json.dumps({
+    log(f"G={G} P={P} scenario={scenario}: {commits} commits in "
+        f"{elapsed:.2f}s over {rounds} rounds ({round_ms:.2f} ms/round) -> "
+        f"{cps:,.0f} commits/s; measured commit latency p50 {p50_ms} ms "
+        f"p99 {p99_ms} ms over {n_lat} proposals")
+
+    out = {
         "metric": f"aggregate_commits_per_sec_{G}_groups_{P}_peers",
         "value": round(cps, 1),
         "unit": "commits/s",
-        "vs_baseline": round(cps / baseline, 2),
-        "p99_commit_latency_ms": round(p99_commit_ms, 2),
+        "vs_baseline": round(cps / BASELINE_WRITES_PER_SEC, 2),
+        "p50_commit_latency_ms": p50_ms,
+        "p99_commit_latency_ms": p99_ms,
         "round_ms": round(round_ms, 3),
-    }))
+        "rounds": rounds,
+        "platform": devs[0].platform,
+        "scenario": scenario,
+    }
+    if scenario == "lag":
+        out["lagged_groups"] = lagged
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Parent: watchdog that guarantees the JSON line
+# ---------------------------------------------------------------------------
+
+def _run_child(extra_env: dict, timeout_s: float):
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["BENCH_CHILD"] = "1"
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=None,
+            timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"bench child timed out after {timeout_s:.0f}s")
+        return None
+    for line in p.stdout.decode(errors="replace").splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            return line
+    log(f"bench child exited rc={p.returncode} without a JSON line")
+    return None
+
+
+def main() -> int:
+    if os.environ.get("BENCH_CHILD") == "1":
+        return child_main()
+
+    budget = float(os.environ.get("BENCH_BUDGET_S", 200.0))
+    t0 = time.time()
+
+    # Attempt 1: ambient platform (real TPU under the driver). The child's
+    # internal deadline must undercut the parent's kill timeout so it always
+    # finishes printing before SIGKILL.
+    line = _run_child({"BENCH_BUDGET_S": str(budget * 0.6)},
+                      timeout_s=budget * 0.65)
+
+    # Attempt 2: forced-CPU fallback with the remaining budget.
+    if line is None:
+        left = budget - (time.time() - t0) - 5.0
+        if left > 20:
+            log("retrying on forced CPU")
+            line = _run_child(
+                {"BENCH_PLATFORM": "cpu",
+                 "BENCH_BUDGET_S": str(left),
+                 "BENCH_GROUPS": os.environ.get("BENCH_GROUPS", "4096"),
+                 "BENCH_ROUNDS": os.environ.get("BENCH_ROUNDS", "40")},
+                timeout_s=left)
+
+    if line is None:
+        line = json.dumps({
+            "metric": "aggregate_commits_per_sec",
+            "value": 0.0,
+            "unit": "commits/s",
+            "vs_baseline": 0.0,
+            "error": "benchmark children timed out (backend init hang?)",
+        })
+    print(line, flush=True)
     return 0
 
 

@@ -464,7 +464,7 @@ def _assemble_sends(st: GroupState, cfg: KernelConfig, resp: jax.Array,
     last = st.last_index[..., None]
     unacked = st.next - 1 - st.match
     paused_eff = _where(st.pr_state == PR_PROBE, st.paused,
-                        unacked >= cfg.flow_window)
+                        unacked >= cfg.effective_flow_window)
     has_gap = st.next <= last
     prev = st.next - 1
     prev_in_win = in_window(st, cfg, prev)

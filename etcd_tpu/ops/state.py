@@ -51,11 +51,20 @@ class KernelConfig(NamedTuple):
     max_ents: int = 4      # E: max entries per append message
     election_tick: int = 10
     heartbeat_tick: int = 1
-    flow_window: int = 1024  # max un-acked entries per follower (replicate)
+    # Max un-acked entries per follower before replication pauses
+    # (entries-in-flight redesign of the reference inflights ring,
+    # progress.go:172-237). 0 = derive window//2, so the pause always
+    # engages BEFORE a silent follower's needed entries can fall off the
+    # on-device log ring.
+    flow_window: int = 0
 
     @property
     def fields(self) -> int:
         return N_FIXED_FIELDS + self.max_ents
+
+    @property
+    def effective_flow_window(self) -> int:
+        return self.flow_window if self.flow_window > 0 else self.window // 2
 
 
 class GroupState(NamedTuple):
@@ -107,14 +116,29 @@ def _seed(groups: int, peers: int) -> np.ndarray:
     return s
 
 
-def init_state(cfg: KernelConfig, n_peers=None) -> GroupState:
+def init_state(cfg: KernelConfig, n_peers=None,
+               stagger: bool = False) -> GroupState:
     """Fresh-boot state: every instance a follower at term 0 with an empty
-    log. `n_peers` may be an int (uniform group size) or a (G,) array."""
+    log. `n_peers` may be an int (uniform group size) or a (G,) array.
+
+    `stagger=True` pre-ages exactly one instance per group (slot g mod n)
+    past its election timeout so it campaigns on the FIRST tick and wins
+    uncontested ~3 rounds later — the deterministic fast-boot the reference
+    gets probabilistically from randomized timeouts (raft.go:765-771).
+    Benchmarks and the multichip dryrun use this to reach steady state in
+    O(1) rounds instead of O(election_tick) with tie retries."""
     G, P = cfg.groups, cfg.peers
     if n_peers is None:
         n_peers = P
     n_peers_arr = jnp.array(np.broadcast_to(np.asarray(n_peers, np.int32),
                                             (G,)))
+    elapsed0 = np.zeros((G, P), np.int32)
+    if stagger:
+        g = np.arange(G)
+        slot = (g % np.asarray(n_peers_arr)).astype(np.int64)
+        # After the first tick, d = 2*tick+1 - tick = tick+1 > any draw in
+        # [0, tick-1] -> guaranteed immediate campaign (see kernel._tick).
+        elapsed0[g, slot] = 2 * cfg.election_tick
 
     # Each field gets its OWN buffer: step() donates the whole state pytree,
     # and XLA rejects donating one buffer twice.
@@ -130,7 +154,7 @@ def init_state(cfg: KernelConfig, n_peers=None) -> GroupState:
         commit=zeros_gp(),
         lead=zeros_gp(),
         state=zeros_gp(),
-        elapsed=zeros_gp(),
+        elapsed=jnp.asarray(elapsed0),
         prng=jnp.asarray(_seed(G, P)),
         log_term=jnp.zeros((G, P, cfg.window), jnp.int32),
         last_index=zeros_gp(),

@@ -1,0 +1,61 @@
+"""Force JAX onto virtual CPU devices, robustly against this image's quirks.
+
+The runtime image preloads jax at interpreter start (axon site hook), so by
+the time user code runs, setting JAX_PLATFORMS in os.environ is too late for
+the platform choice — the preloaded jax captured the ambient config whose
+'axon' TPU backend dials a tunnel that can hang forever when unreachable.
+The platform must be forced through jax.config.update; XLA_FLAGS is still
+read lazily at CPU-client creation, so the device count rides the env var
+(replacing any stale value already present).
+
+Single source of truth for bench.py, __graft_entry__.py and
+tests/conftest.py (they previously carried divergent copies).
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def set_host_device_count(n: int) -> None:
+    """Set (or raise to n) the virtual CPU device count in XLA_FLAGS.
+    Only effective before the CPU backend is instantiated."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = flags[:m.start(1)] + str(n) + flags[m.end(1):]
+    os.environ["XLA_FLAGS"] = flags
+
+
+def force_cpu(n_devices: int = 1):
+    """Force JAX onto >= n_devices virtual CPU devices regardless of the
+    ambient platform config; returns the device list. If a backend was
+    already instantiated on the wrong platform/count, clears and re-inits
+    (best effort — goes through a private jax API)."""
+    set_host_device_count(n_devices)
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    devs = jax.devices()
+    if devs[0].platform != "cpu" or len(devs) < n_devices:
+        try:
+            from jax._src import api as _api
+            _api.clear_backends()
+        except Exception:
+            pass
+        else:
+            devs = jax.devices()
+    if devs[0].platform != "cpu" or len(devs) < n_devices:
+        raise RuntimeError(
+            f"cannot get {n_devices} cpu devices: have "
+            f"{len(devs)} x {devs[0].platform}")
+    return devs

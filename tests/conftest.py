@@ -1,27 +1,21 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh so sharding
 paths compile/execute without TPU hardware (the driver separately dry-runs the
-multi-chip path; see __graft_entry__.py).
-
-IMPORTANT: this image preloads jax at interpreter start (axon site hook), so
-setting JAX_PLATFORMS in os.environ here is too late — the already-imported
-jax captured the ambient "axon" platform config, whose backend init dials a
-TPU tunnel that can hang. Force the platform through jax.config.update, which
-works any time before the first backend is instantiated. XLA_FLAGS is still
-read lazily at CPU-client creation, so the env route works for the device
-count.
-"""
+multi-chip path; see __graft_entry__.py). The forcing logic — robust against
+this image's jax preload (axon site hook) — is shared with bench.py and
+__graft_entry__.py via etcd_tpu.utils.platform."""
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"   # for any subprocesses tests spawn
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
-import jax  # noqa: E402  (preloaded anyway — see module docstring)
+from etcd_tpu.utils.platform import force_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)
+
+import jax  # noqa: E402
+
 jax.config.update("jax_enable_x64", True)
 
 

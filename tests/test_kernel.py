@@ -132,6 +132,60 @@ def test_commit_propagates_to_followers_via_heartbeat():
             assert commit[g, p] == lead_commit[g], (g, p, commit[g])
 
 
+def test_staggered_init_elects_in_three_rounds():
+    cfg = KernelConfig(groups=16, peers=5)
+    st = init_state(cfg, stagger=True)
+    st, _ = run_rounds(cfg, st, 3)
+    n_leaders = np.asarray((st.state == LEADER)).sum(axis=1)
+    assert (n_leaders == 1).all()
+    # the staggered slot g % P is the winner
+    slots = leader_slot(st)
+    assert (slots == np.arange(16) % 5).all()
+
+
+def test_flow_window_pauses_replication_to_partitioned_follower():
+    """A silent follower must stop receiving appends once
+    effective_flow_window (window//2) entries are un-acked — BEFORE its
+    needed entries fall off the device ring (reference inflights semantics,
+    progress.go:172-237, re-expressed as entries-in-flight)."""
+    cfg = KernelConfig(groups=2, peers=3, window=8, max_ents=2)
+    assert cfg.effective_flow_window == 4
+    st = init_state(cfg, stagger=True)
+    # Elect, then run a few live rounds so every follower acks at least once
+    # and the leader's progress reaches REPLICATE (a never-acked follower
+    # stays in PROBE, which paces at one probe per heartbeat instead).
+    st, inbox = run_rounds(cfg, st, 6)
+    slots = leader_slot(st)
+    assert (slots >= 0).all()
+    g = np.arange(cfg.groups)
+    dead = (slots + 1) % cfg.peers  # partition one non-leader slot
+    from etcd_tpu.ops.state import PR_REPLICATE
+    assert (np.asarray(st.pr_state)[g, slots, dead] == PR_REPLICATE).all()
+
+    def drop(r, inbox):
+        arr = np.array(inbox)   # writable copy
+        g = np.arange(cfg.groups)
+        arr[g, dead] = 0        # nothing delivered TO the dead slot
+        arr[g, :, dead] = 0     # nothing delivered FROM it
+        return jnp.asarray(arr)
+
+    def props(r, cur):
+        return (jnp.full(cfg.groups, cfg.max_ents, jnp.int32),
+                jnp.asarray(slots, jnp.int32))
+
+    st, _ = run_rounds(cfg, st, 12, inbox=inbox, props=props, drop=drop)
+    nxt = np.asarray(st.next)[g, slots, dead]
+    match = np.asarray(st.match)[g, slots, dead]
+    unacked = nxt - 1 - match
+    # in-flight to the dead follower capped exactly at the flow window
+    assert (unacked <= cfg.effective_flow_window).all(), unacked
+    assert (unacked == cfg.effective_flow_window).all(), (
+        "pause engaged early", unacked)
+    # the live majority kept committing meanwhile
+    commit = np.asarray(st.commit)[g, slots]
+    assert (commit >= 10).all(), commit
+
+
 def test_leader_unique_per_term_under_chaos():
     cfg, st = make(groups=6, peers=5)
     rng = np.random.RandomState(7)
