@@ -468,11 +468,18 @@ def _assemble_sends(st: GroupState, cfg: KernelConfig, resp: jax.Array,
     has_gap = st.next <= last
     prev = st.next - 1
     prev_in_win = in_window(st, cfg, prev)
+    # Entries next..next+n-1 must ALSO be resolvable from the sender's ring
+    # (next > last - W). prev == 0 passes in_window via the empty-log
+    # special case, but once last > W the ring no longer holds entry 1 —
+    # without this guard the term gather below would alias modulo W and
+    # ship garbage terms to an empty/new follower.
+    ents_ok = st.next > last - cfg.window
+    sendable = prev_in_win & ents_ok
     # Target lags below the device window -> host must ship a snapshot.
-    need_snap = is_ldr & tgt_ok & has_gap & ~prev_in_win
+    need_snap = is_ldr & tgt_ok & has_gap & ~sendable
     st = st._replace(need_host=st.need_host | jnp.any(need_snap, axis=2))
 
-    send_app = is_ldr & tgt_ok & has_gap & ~paused_eff & prev_in_win
+    send_app = is_ldr & tgt_ok & has_gap & ~paused_eff & sendable
     n = jnp.minimum(last - st.next + 1, E)
     n = _where(send_app, n, 0)
 

@@ -97,8 +97,12 @@ class GroupState(NamedTuple):
     # 2 rejected, per voter slot:
     votes: jax.Array         # (G, P, P) int32
 
-    # Membership: number of active peer slots per group (slots 0..n-1 live).
-    n_peers: jax.Array       # (G,) int32
+    # Membership: which peer slots are live. A device-side ConfChange is a
+    # bit flip here (add = set a free slot, remove = clear it — the removed
+    # slot's rows go inert, no compaction), applied by the host engine at a
+    # committed boundary (reference multinode.go:181-218 CreateGroup/
+    # RemoveGroup + raft.go:709-744 addNode/removeNode).
+    peer_mask: jax.Array     # (G, P) bool
 
     # Host-escape flags: group needs the scalar slow path (snapshot send,
     # append below the device window, safety check failure).
@@ -130,12 +134,12 @@ def init_state(cfg: KernelConfig, n_peers=None,
     G, P = cfg.groups, cfg.peers
     if n_peers is None:
         n_peers = P
-    n_peers_arr = jnp.array(np.broadcast_to(np.asarray(n_peers, np.int32),
-                                            (G,)))
+    n_peers_np = np.broadcast_to(np.asarray(n_peers, np.int32), (G,))
+    mask0 = np.arange(P, dtype=np.int32)[None, :] < n_peers_np[:, None]
     elapsed0 = np.zeros((G, P), np.int32)
     if stagger:
         g = np.arange(G)
-        slot = (g % np.asarray(n_peers_arr)).astype(np.int64)
+        slot = (g % n_peers_np).astype(np.int64)
         # After the first tick, d = 2*tick+1 - tick = tick+1 > any draw in
         # [0, tick-1] -> guaranteed immediate campaign (see kernel._tick).
         elapsed0[g, slot] = 2 * cfg.election_tick
@@ -163,20 +167,19 @@ def init_state(cfg: KernelConfig, n_peers=None,
         pr_state=zeros_gpp(),
         paused=jnp.zeros((G, P, P), bool),
         votes=zeros_gpp(),
-        n_peers=n_peers_arr,
+        peer_mask=jnp.asarray(mask0),
         need_host=jnp.zeros((G, P), bool),
     )
 
 
 def active_mask(st: GroupState) -> jax.Array:
     """(G, P) bool: which peer slots exist."""
-    P = st.term.shape[1]
-    return jnp.arange(P, dtype=jnp.int32)[None, :] < st.n_peers[:, None]
+    return st.peer_mask
 
 
 def quorum(st: GroupState) -> jax.Array:
     """(G,) int32: n//2 + 1 (reference raft.go:215)."""
-    return st.n_peers // 2 + 1
+    return jnp.sum(st.peer_mask.astype(jnp.int32), axis=1) // 2 + 1
 
 
 def term_at(st: GroupState, cfg: KernelConfig, index: jax.Array) -> jax.Array:

@@ -45,12 +45,11 @@ def state_sharding(mesh: Mesh) -> GroupState:
     target-peer axis and the log window stay replicated within a shard."""
     gp = NamedSharding(mesh, P("groups", "peers"))
     gpx = NamedSharding(mesh, P("groups", "peers", None))
-    g = NamedSharding(mesh, P("groups"))
     return GroupState(
         term=gp, vote=gp, commit=gp, lead=gp, state=gp, elapsed=gp, prng=gp,
         log_term=gpx, last_index=gp,
         match=gpx, next=gpx, pr_state=gpx, paused=gpx, votes=gpx,
-        n_peers=g, need_host=gp,
+        peer_mask=gp, need_host=gp,
     )
 
 

@@ -79,7 +79,8 @@ def test_noop_commits_in_quiescent_group():
 
 def test_single_peer_group_instant_leader():
     cfg, st = make(groups=2, peers=3)
-    st = st._replace(n_peers=jnp.array([1, 3], jnp.int32))
+    st = st._replace(peer_mask=jnp.array([[True, False, False],
+                                          [True, True, True]]))
     st, _ = run_rounds(cfg, st, 25)
     assert np.asarray(st.state)[0, 0] == LEADER
     # Inactive slots never move.
