@@ -62,6 +62,8 @@ _MESSAGES = {
 # are the exceptions.
 _STATUS = {
     ECODE_KEY_NOT_FOUND: 404,
+    ECODE_TEST_FAILED: 412,
+    ECODE_NODE_EXIST: 412,
     ECODE_NOT_FILE: 403,
     ECODE_DIR_NOT_EMPTY: 403,
     ECODE_UNAUTHORIZED: 401,

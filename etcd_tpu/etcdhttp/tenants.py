@@ -1,0 +1,158 @@
+"""Multi-tenant HTTP surface for the batched MultiNode engine: the full
+/v2/keys matrix served per consensus group from ONE kernel.
+
+Routes (the multi-tenant re-framing of reference etcdserver/etcdhttp —
+each tenant group gets the same client API one etcd cluster exposes):
+
+    /tenants/{g}/v2/keys/...   full v2 keys CRUD/CAS/CAD/watch (reuses
+                               ClientAPI via a per-tenant server adapter)
+    /tenants/{g}/status        group consensus status (leader, term,
+                               commit, applied, active slots)
+    /tenants/{g}/conf          POST {"op": "add"|"remove", "slot": n} —
+                               membership change through the group's own
+                               consensus (reference /v2/members semantics)
+    /engine/status             engine-wide summary
+    /health, /version          liveness + version (reference client.go)
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict
+
+from etcd_tpu import errors, version
+from etcd_tpu.etcdhttp.client import ClientAPI
+from etcd_tpu.etcdhttp.web import Ctx, HttpServer, Router
+
+
+class _TenantCluster:
+    """Just enough cluster surface for ClientAPI._headers."""
+
+    def __init__(self, g: int) -> None:
+        self.cluster_id = g
+
+
+class _TenantServer:
+    """Adapts one engine group to the `server` interface ClientAPI drives
+    (do/store/clock/stopped/commit_index/term), so the entire keys path —
+    parsing, CAS/CAD, long-poll + stream watch — is shared verbatim with
+    the single-cluster server (etcdhttp/client.py)."""
+
+    def __init__(self, engine, g: int) -> None:
+        self._engine = engine
+        self._g = g
+        self.cluster = _TenantCluster(g)
+        self.clock = time.time
+
+    def do(self, r):
+        return self._engine.do(self._g, r)
+
+    @property
+    def store(self):
+        return self._engine.store(self._g)
+
+    @property
+    def stopped(self) -> bool:
+        return self._engine._stop_ev.is_set()
+
+    @property
+    def commit_index(self) -> int:
+        return int(self._engine.h_commit[self._g].max())
+
+    @property
+    def term(self) -> int:
+        return int(self._engine.h_term[self._g].max())
+
+
+class TenantAPI:
+    """Router glue: dispatches /tenants/{g}/... to per-tenant ClientAPIs."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self._apis: Dict[int, ClientAPI] = {}
+
+    def install(self, router: Router) -> None:
+        router.add("/tenants/", self.handle_tenants)
+        router.add("/engine/status", self.handle_engine_status)
+        router.add("/health", self.handle_health)
+        router.add("/version", self.handle_version)
+
+    def _api(self, g: int) -> ClientAPI:
+        api = self._apis.get(g)
+        if api is None:
+            api = self._apis[g] = ClientAPI(_TenantServer(self.engine, g))
+        return api
+
+    def handle_tenants(self, ctx: Ctx, suffix: str) -> None:
+        parts = suffix.split("/", 1)
+        rest = parts[1] if len(parts) > 1 else ""
+        try:
+            g = int(parts[0])
+            if not 0 <= g < self.engine.cfg.groups:
+                raise ValueError
+        except ValueError:
+            ctx.send_json(404, {"message": f"no such tenant {parts[0]!r}"})
+            return
+        if rest == "v2/keys" or rest.startswith("v2/keys/"):
+            self._api(g).handle_keys(ctx, rest[len("v2/keys"):])
+        elif rest == "status":
+            ctx.send_json(200, self.engine.status(g))
+        elif rest == "conf":
+            self._handle_conf(ctx, g)
+        else:
+            ctx.send_json(404, {"message": f"unknown tenant path {rest!r}"})
+
+    def _handle_conf(self, ctx: Ctx, g: int) -> None:
+        if ctx.method != "POST":
+            ctx.send(405, b"Method Not Allowed", headers={"Allow": "POST"})
+            return
+        try:
+            d = json.loads(ctx.body.decode() or "{}")
+            slots = self.engine.conf_change(g, d["op"], int(d["slot"]))
+        except errors.EtcdError as e:
+            ctx.send(e.status_code, e.to_json().encode() + b"\n",
+                     "application/json")
+            return
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            ctx.send_json(400, {"message": f"bad conf body: {e}"})
+            return
+        ctx.send_json(200, {"group": g, "active_slots": slots})
+
+    def handle_engine_status(self, ctx: Ctx, suffix: str) -> None:
+        eng = self.engine
+        leaders = sum(1 for g in range(eng.cfg.groups)
+                      if eng.leader_slot(g) >= 0)
+        ctx.send_json(200, {
+            "groups": eng.cfg.groups,
+            "peers": eng.cfg.peers,
+            "round": eng.round_no,
+            "groups_with_leader": leaders,
+            "applied_total": int(eng.applied.sum()),
+        })
+
+    def handle_health(self, ctx: Ctx, suffix: str) -> None:
+        ctx.send_json(200, {"health": "true"})
+
+    def handle_version(self, ctx: Ctx, suffix: str) -> None:
+        ctx.send_json(200, {"releaseVersion": version.VERSION})
+
+
+class EngineHttp:
+    """A listening HTTP front for a MultiEngine."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.engine = engine
+        router = Router()
+        self.api = TenantAPI(engine)
+        self.api.install(router)
+        self.http = HttpServer(host, port, router)
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    def start(self) -> None:
+        self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()

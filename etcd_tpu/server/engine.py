@@ -1,0 +1,793 @@
+"""MultiEngine: the batched MultiNode host engine — G Raft groups served
+from ONE TPU kernel, the north star's serving path.
+
+This is the integrated run loop the reference implements per-process in
+raft.MultiNode (raft/multinode.go:166-322) + raftNode (etcdserver/raft.go:
+112-172), re-expressed for the batched kernel (etcd_tpu/ops/kernel.py):
+
+  one engine round =
+    batch proposals -> kernel.step (ONE XLA program for all G x P) ->
+    read back state deltas -> EngineWAL append+fsync (persist BEFORE the
+    next round consumes this round's messages — the batched form of the
+    doc.go:31-39 ordering contract) -> apply committed entries to the
+    per-group stores -> trigger client waiters -> consume need_host flags
+    (snapshot-install lagging followers via host-side state surgery).
+
+Entry payloads never touch the device: the kernel commits (index, term)
+metadata; payloads live in the host log store keyed (group, index, term) —
+the Raft log-matching invariant makes that key unique, so leader turnover
+overwrites at an index can never alias a committed payload. Leader no-op
+entries are simply absent from the payload store and skip application.
+
+Crash model: ALL P peer slots of a group live in this process, so a crash
+is a whole-cluster crash — restart reconstructs every slot from the newest
+checkpoint + WAL replay at the last durable round boundary. Nothing after
+that boundary was ever acked to a client (applies happen after the WAL
+fsync), so the restart is externally indistinguishable from a crash of a
+real P-member cluster at that instant. In the multi-host deployment (peers
+axis sharded over the mesh, parallel/mesh.py) each host persists only its
+own slots; this engine is the single-host/multi-tenant serving path.
+
+Membership changes are committed entries (reference multinode.go:181-218
+CreateGroup-/RemoveGroup-at-commit semantics): applying one flips a bit in
+the device peer_mask and resets the affected progress column; a joining
+empty slot is then caught up by the leader (direct appends while within the
+ring window, host snapshot-install beyond it).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from etcd_tpu import errors
+from etcd_tpu.server.enginewal import (CONF_ADD, CONF_REMOVE, EngineWAL,
+                                       RoundRecord, b64_np, np_b64)
+from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
+                                     METHOD_PUT, METHOD_QGET, METHOD_SYNC,
+                                     Request)
+from etcd_tpu.store import Store
+from etcd_tpu.utils import idutil
+from etcd_tpu.utils.wait import Wait
+
+log = logging.getLogger("etcd_tpu.engine")
+
+# Payload tags (first byte of every entry payload).
+P_REQ = 0x00    # etcd v2 Request (JSON)
+P_CONF = 0x01   # membership change (JSON {"id", "op", "slot"})
+
+_LEADER = 2  # ops.state.LEADER (kept in sync; imported lazily with jax)
+
+
+@dataclass
+class EngineConfig:
+    groups: int
+    peers: int
+    data_dir: str
+    window: int = 32
+    max_ents: int = 8
+    election_tick: int = 10
+    heartbeat_tick: int = 3
+    fsync: bool = True
+    checkpoint_rounds: int = 2048     # rounds between full checkpoints
+    request_timeout: float = 5.0
+    round_interval: float = 0.0       # seconds between rounds (0 = flat out)
+    ticks_per_round: int = 1          # logical clock rate
+    stagger: bool = True              # deterministic fast first election
+    initial_peers: Optional[int] = None  # active slots at fresh boot (<= peers)
+
+
+class MultiEngine:
+    """G consensus groups stepped by the batched kernel, served as G
+    independent etcd v2 keyspaces ("tenants")."""
+
+    def __init__(self, cfg: EngineConfig) -> None:
+        # jax imports deferred so constructing configs stays cheap.
+        import jax
+        import jax.numpy as jnp
+        from etcd_tpu.ops import kernel
+        from etcd_tpu.ops.state import (KernelConfig, LEADER, init_state)
+
+        assert LEADER == _LEADER
+        self._jax, self._jnp, self._kernel = jax, jnp, kernel
+        self.cfg = cfg
+        self.kcfg = KernelConfig(
+            groups=cfg.groups, peers=cfg.peers, window=cfg.window,
+            max_ents=cfg.max_ents, election_tick=cfg.election_tick,
+            heartbeat_tick=cfg.heartbeat_tick)
+        G, P, W = cfg.groups, cfg.peers, cfg.window
+
+        self.wal = EngineWAL(cfg.data_dir, fsync=cfg.fsync)
+        self.wait = Wait()
+        self.reqid = idutil.Generator(1)
+        self._pending: List[deque] = [deque() for _ in range(G)]
+        self._dirty: set = set()            # groups with queued proposals
+        self._staged: Dict[int, List[Tuple[int, bytes]]] = {}
+        self._stores: Dict[int, Store] = {}
+        self._lock = threading.Lock()       # guards _pending/_dirty enqueue
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.round_no = 0
+
+        # Host mirrors of the last read-back device state.
+        self.h_term = np.zeros((G, P), np.int32)
+        self.h_vote = np.zeros((G, P), np.int32)
+        self.h_commit = np.zeros((G, P), np.int32)
+        self.h_state = np.zeros((G, P), np.int32)
+        self.h_last = np.zeros((G, P), np.int32)
+        self.h_ring = np.zeros((G, P, W), np.int32)
+        self.h_mask = np.zeros((G, P), bool)
+        self.applied = np.zeros(G, np.int64)
+        self.payloads: Dict[Tuple[int, int, int], bytes] = {}
+
+        ckpt_round, ckpt = self.wal.load_checkpoint()
+        # Full consumption also positions the writer (next segment seq) and
+        # seeds the rolling CRC for appends.
+        recs = list(self.wal.replay(after_round=ckpt_round))
+        if ckpt is not None or recs:
+            self._restore(ckpt_round, ckpt, recs)
+        else:
+            self.st = init_state(self.kcfg, n_peers=cfg.initial_peers,
+                                 stagger=cfg.stagger)
+            self.h_mask = np.asarray(self.st.peer_mask).copy()
+        self.inbox = jnp.zeros((G, P, P, self.kcfg.fields), jnp.int32)
+        self._zero = jnp.zeros(G, jnp.int32)
+        # Chaos hook: (G, P_to, P_from, 1)-broadcastable 0/1 mask applied to
+        # the routed inbox (tests inject drops/partitions here).
+        self.drop_mask = None
+
+    # ------------------------------------------------------------------
+    # restore
+    # ------------------------------------------------------------------
+
+    def _restore(self, ckpt_round: int, ckpt: Optional[dict],
+                 recs: List[RoundRecord]) -> None:
+        """Rebuild host mirrors + device state from checkpoint + WAL replay.
+        Every slot restarts as a follower with its replayed log, term, vote
+        and commit (reference RestartNode semantics, raft/node.go:186-192)."""
+        from etcd_tpu.ops.state import init_state
+        jnp = self._jnp
+        G, P, W = self.cfg.groups, self.cfg.peers, self.cfg.window
+
+        base = init_state(self.kcfg, n_peers=self.cfg.initial_peers,
+                          stagger=self.cfg.stagger)
+        self.h_mask = np.asarray(base.peer_mask).copy()
+        if ckpt is not None:
+            self.h_term = b64_np(ckpt["term"]).astype(np.int32)
+            self.h_vote = b64_np(ckpt["vote"]).astype(np.int32)
+            self.h_commit = b64_np(ckpt["commit"]).astype(np.int32)
+            self.h_last = b64_np(ckpt["last"]).astype(np.int32)
+            self.h_ring = b64_np(ckpt["ring"]).astype(np.int32)
+            self.h_mask = b64_np(ckpt["mask"]).astype(bool)
+            self.applied = b64_np(ckpt["applied"]).astype(np.int64)
+            for g_s, blob in ckpt["stores"].items():
+                st = Store()
+                st.recovery(blob.encode())
+                self._stores[int(g_s)] = st
+            for g, i, t, b64p in ckpt["payloads"]:
+                import base64 as _b64
+                self.payloads[(g, i, t)] = _b64.b64decode(b64p)
+
+        # Per-slot log terms reconstructed from history: the final ring only
+        # covers the last W entries, but the restart apply span can reach
+        # further back (committed-but-unapplied suffix). Seed from the
+        # checkpoint's ring, then track BOTH ring deltas (term rewrites —
+        # conflicts always change the term) and last_index advances (a
+        # same-term append leaves its ring slot's VALUE unchanged when it
+        # aliases an equal-term entry, so it is only visible as growth).
+        slot_log: Dict[Tuple[int, int], Dict[int, int]] = {}
+
+        def _log_set(g, p, i, t):
+            slot_log.setdefault((int(g), int(p)), {})[int(i)] = int(t)
+
+        if ckpt is not None:
+            for g in range(G):
+                for p in range(P):
+                    lastv = int(self.h_last[g, p])
+                    for w in range(W):
+                        i = lastv - ((lastv - w) % W)
+                        if i >= 1:
+                            _log_set(g, p, i, self.h_ring[g, p, w])
+
+        last_round = ckpt_round
+        for rec in recs:
+            last_round = max(last_round, rec.round_no)
+            gi = rec.hs_g.astype(np.int64)
+            pi = rec.hs_p.astype(np.int64)
+            self.h_term[gi, pi] = rec.hs_term
+            self.h_vote[gi, pi] = rec.hs_vote
+            self.h_commit[gi, pi] = rec.hs_commit
+            # Ring deltas first: the round's appends need the post-round
+            # ring to resolve their terms.
+            gi = rec.ring_g.astype(np.int64)
+            pi = rec.ring_p.astype(np.int64)
+            self.h_ring[gi, pi, rec.ring_i.astype(np.int64) % W] = rec.ring_t
+            for g, p, i, t in zip(rec.ring_g, rec.ring_p, rec.ring_i,
+                                  rec.ring_t):
+                _log_set(g, p, i, t)
+            for g, p, new in zip(rec.last_g.astype(np.int64),
+                                 rec.last_p.astype(np.int64),
+                                 rec.last_v.astype(np.int64)):
+                prev = int(self.h_last[g, p])
+                self.h_last[g, p] = new
+                for i in range(max(prev + 1, int(new) - W + 1), int(new) + 1):
+                    _log_set(g, p, i, self.h_ring[g, p, i % W])
+            for g, i, t, payload in rec.entries:
+                self.payloads[(g, i, t)] = payload
+            for g, slot, op in rec.confs:
+                self.h_mask[g, slot] = (op == CONF_ADD)
+                if op == CONF_ADD:
+                    # Live _apply_conf zeroes a joining slot's state (it may
+                    # have a stale former life); replay must match, or the
+                    # restarted slot would claim a log it no longer has.
+                    self.h_term[g, slot] = 0
+                    self.h_vote[g, slot] = 0
+                    self.h_commit[g, slot] = 0
+                    self.h_last[g, slot] = 0
+                    self.h_ring[g, slot] = 0
+                    slot_log.pop((int(g), int(slot)), None)
+        self.round_no = last_round + 1
+
+        # Device state: followers everywhere, logs/HS restored.
+        self.st = base._replace(
+            term=jnp.asarray(self.h_term),
+            vote=jnp.asarray(self.h_vote),
+            commit=jnp.asarray(self.h_commit),
+            last_index=jnp.asarray(self.h_last),
+            log_term=jnp.asarray(self.h_ring),
+            peer_mask=jnp.asarray(self.h_mask),
+        )
+        self.h_state = np.zeros((G, P), np.int32)  # all followers
+        # Committed terms across ALL slots: where committed, every slot's
+        # log agrees at an index (log matching), so any slot with
+        # commit >= i supplies THE term. Zero terms are placeholder slots
+        # (e.g. zeroed by a snapshot install) and are skipped.
+        hist: Dict[Tuple[int, int], int] = {}
+        for (g, p), entries in slot_log.items():
+            c = int(self.h_commit[g, p])
+            lastv = int(self.h_last[g, p])
+            for i, t in entries.items():
+                if t > 0 and i <= c and i <= lastv:
+                    hist.setdefault((g, i), t)
+        # Re-apply the committed-but-unapplied suffix; hist supplies entry
+        # terms older than the live ring window.
+        self._apply_committed(trigger=False, hist=hist)
+        self._gc_payloads()
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="multi-engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.wal.close()
+
+    def store(self, g: int) -> Store:
+        s = self._stores.get(g)
+        if s is None:
+            # Lock: HTTP handler threads race the engine apply thread on
+            # first touch of a tenant; an unsynchronized check-then-set
+            # could discard a Store already holding applied writes.
+            with self._lock:
+                s = self._stores.get(g)
+                if s is None:
+                    s = self._stores[g] = Store()
+        return s
+
+    def leader_slot(self, g: int) -> int:
+        """The group's current leader slot, or -1. Only ACTIVE slots count —
+        a just-removed slot's device row freezes in whatever state it held
+        (reference removed-member tombstones make its traffic inert the same
+        way, server.go:387-391)."""
+        row = np.where(self.h_mask[g], self.h_state[g], 0)
+        idx = np.nonzero(row == _LEADER)[0]
+        return int(idx[0]) if len(idx) else -1
+
+    def wait_leaders(self, timeout: float = 30.0, groups=None) -> bool:
+        """Block until every (requested) group has a leader."""
+        deadline = time.monotonic() + timeout
+        gs = range(self.cfg.groups) if groups is None else groups
+        while time.monotonic() < deadline:
+            if all(self.leader_slot(g) >= 0 for g in gs):
+                return True
+            time.sleep(0.005)
+        return False
+
+    def do(self, g: int, r: Request, timeout: Optional[float] = None) -> Any:
+        """Serve one request against group g (the engine's Do,
+        reference server.go:519-576). Reads are local; writes ride the
+        kernel's consensus."""
+        if r.method == METHOD_GET:
+            if r.quorum:
+                r = Request(**{**r.__dict__, "method": METHOD_QGET})
+            elif r.wait:
+                return self.store(g).watch(r.path, r.recursive, r.stream,
+                                           r.since)
+            else:
+                return self.store(g).get(r.path, r.recursive, r.sorted)
+        if r.method not in (METHOD_PUT, METHOD_POST, METHOD_DELETE,
+                            METHOD_QGET, METHOD_SYNC):
+            raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                                   cause=f"bad method {r.method}")
+        if r.id == 0:
+            r = Request(**{**r.__dict__, "id": self.reqid.next()})
+        q = self.wait.register(r.id)
+        payload = bytes([P_REQ]) + r.encode()
+        with self._lock:
+            self._pending[g].append((r.id, payload))
+            self._dirty.add(g)
+        try:
+            result = q.get(timeout=timeout or self.cfg.request_timeout)
+        except queue.Empty:
+            self.wait.cancel(r.id)
+            raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
+                                   cause="request timed out",
+                                   index=int(self.applied[g]))
+        if isinstance(result, errors.EtcdError):
+            raise result
+        return result
+
+    def conf_change(self, g: int, op: str, slot: int,
+                    timeout: Optional[float] = None) -> List[int]:
+        """Propose a membership change for group g through its own
+        consensus; returns the new active slot list (reference
+        configure() server.go:640-662 + multinode group management)."""
+        if not 0 <= slot < self.cfg.peers:
+            raise ValueError(f"slot {slot} out of range")
+        if op == "add":
+            if self.h_mask[g, slot]:
+                raise errors.EtcdError(errors.ECODE_NODE_EXIST,
+                                       cause=f"slot {slot} already active")
+        elif op == "remove":
+            if not self.h_mask[g, slot]:
+                raise errors.EtcdError(errors.ECODE_KEY_NOT_FOUND,
+                                       cause=f"slot {slot} not active")
+        else:
+            raise ValueError(op)
+        rid = self.reqid.next()
+        payload = bytes([P_CONF]) + json.dumps(
+            {"id": rid, "op": op, "slot": slot}).encode()
+        q = self.wait.register(rid)
+        with self._lock:
+            self._pending[g].append((rid, payload))
+            self._dirty.add(g)
+        try:
+            result = q.get(timeout=timeout or self.cfg.request_timeout)
+        except queue.Empty:
+            self.wait.cancel(rid)
+            raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
+                                   cause="conf change timed out")
+        if isinstance(result, errors.EtcdError):
+            raise result
+        return result
+
+    def status(self, g: int) -> dict:
+        """Introspection snapshot for one group (/debug/vars analogue)."""
+        lead = self.leader_slot(g)
+        return {
+            "group": g,
+            "lead": lead,
+            "term": int(self.h_term[g].max()),
+            "commit": int(self.h_commit[g].max()),
+            "applied": int(self.applied[g]),
+            "active_slots": [int(s) for s in np.nonzero(self.h_mask[g])[0]],
+        }
+
+    # ------------------------------------------------------------------
+    # the round
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop_ev.is_set():
+            self.run_round()
+            if self.cfg.round_interval:
+                time.sleep(self.cfg.round_interval)
+
+    def run_round(self) -> None:
+        """One engine round. Callable directly (tests drive the engine
+        synchronously); the background thread just loops it."""
+        jnp, kernel = self._jnp, self._kernel
+        G, P, W, E = (self.cfg.groups, self.cfg.peers, self.cfg.window,
+                      self.cfg.max_ents)
+
+        # -- 1. stage proposals at known leaders --------------------------
+        prop_count = np.zeros(G, np.int32)
+        prop_slot = np.zeros(G, np.int32)
+        self._staged.clear()
+        with self._lock:
+            for g in list(self._dirty):
+                dq = self._pending[g]
+                if not dq:
+                    self._dirty.discard(g)
+                    continue
+                s = self.leader_slot(g)
+                if s < 0:
+                    continue
+                batch = [dq.popleft() for _ in range(min(len(dq), E))]
+                if not dq:
+                    self._dirty.discard(g)
+                self._staged[g] = batch
+                prop_count[g] = len(batch)
+                prop_slot[g] = s
+
+        # -- 2. the kernel round ------------------------------------------
+        tick = (self.round_no % self.cfg.ticks_per_round) == 0
+        st, outbox = kernel.step(
+            self.kcfg, self.st, self.inbox,
+            jnp.asarray(prop_count), jnp.asarray(prop_slot),
+            jnp.asarray(bool(tick)))
+        inbox = kernel.route_local(outbox)
+        if self.drop_mask is not None:
+            inbox = inbox * self.drop_mask
+        self.st = st
+        self.inbox = inbox
+
+        # -- 3. read back -------------------------------------------------
+        (term, vote, commit, state, last, ring, need_host) = (
+            np.array(a) for a in
+            self._jax.device_get((st.term, st.vote, st.commit, st.state,
+                                  st.last_index, st.log_term, st.need_host)))
+
+        # -- 4. durable round record --------------------------------------
+        rec = RoundRecord(round_no=self.round_no)
+        chg = (term != self.h_term) | (vote != self.h_vote) | \
+              (commit != self.h_commit)
+        gi, pi = np.nonzero(chg)
+        rec.hs_g, rec.hs_p = gi.astype(np.uint32), pi.astype(np.uint16)
+        rec.hs_term = term[gi, pi].astype(np.uint32)
+        rec.hs_vote = vote[gi, pi].astype(np.uint16)
+        rec.hs_commit = commit[gi, pi].astype(np.uint32)
+
+        gi, pi = np.nonzero(last != self.h_last)
+        rec.last_g, rec.last_p = gi.astype(np.uint32), pi.astype(np.uint16)
+        rec.last_v = last[gi, pi].astype(np.uint32)
+
+        gi, pi, wi = np.nonzero(ring != self.h_ring)
+        lastv = last[gi, pi]
+        # ring slot w holds absolute index i = last - ((last - w) mod W)
+        absi = lastv - ((lastv - wi) % W)
+        keep = absi >= 1
+        rec.ring_g = gi[keep].astype(np.uint32)
+        rec.ring_p = pi[keep].astype(np.uint16)
+        rec.ring_i = absi[keep].astype(np.uint32)
+        rec.ring_t = ring[gi[keep], pi[keep], wi[keep]].astype(np.uint32)
+
+        # Index assignment for admitted proposals: a pre-existing leader
+        # admits in order at prev_last+1.. (its last_index can move this
+        # round ONLY by admission: it was already leader, so no no-op, and
+        # leaders ignore MsgApp).
+        requeue: List[Tuple[int, List[Tuple[int, bytes]]]] = []
+        for g, batch in self._staged.items():
+            s = prop_slot[g]
+            admitted = 0
+            if (state[g, s] == _LEADER and
+                    term[g, s] == self.h_term[g, s]):
+                admitted = int(last[g, s] - self.h_last[g, s])
+            t = int(term[g, s])
+            for j, (rid, payload) in enumerate(batch):
+                if j < admitted:
+                    i = int(self.h_last[g, s]) + 1 + j
+                    self.payloads[(g, i, t)] = payload
+                    rec.entries.append((g, i, t, payload))
+                else:
+                    requeue.append((g, batch[j:]))
+                    break
+        with self._lock:
+            for g, rest in requeue:
+                self._pending[g].extendleft(reversed(rest))
+                self._dirty.add(g)
+
+        self.h_term, self.h_vote, self.h_commit = term, vote, commit
+        self.h_state, self.h_last, self.h_ring = state, last, ring
+
+        # -- 5+6. persist, then apply + ack -------------------------------
+        # Membership flips committed this round must be in the SAME durable
+        # record as the round that commits them (replay re-applies them),
+        # so collect them before the append, apply after.
+        rec.confs.extend(self._collect_committed_confs())
+        if not rec.is_empty():
+            self.wal.append(rec)
+        self._apply_committed(trigger=True)
+
+        # -- 7. need_host: snapshot-install lagging followers -------------
+        if need_host.any():
+            self._service_need_host(need_host)
+
+        self.round_no += 1
+        if self.round_no % self.cfg.checkpoint_rounds == 0:
+            self._checkpoint()
+            self._gc_payloads()
+
+    # ------------------------------------------------------------------
+    # apply
+    # ------------------------------------------------------------------
+
+    def _group_commit(self) -> np.ndarray:
+        c = np.where(self.h_mask, self.h_commit, 0)
+        return c.max(axis=1)
+
+    def _committed_span(self, g: int):
+        """(slot, lo, hi] apply span for group g using the slot that has
+        the highest commit (its ring covers the span: the admission
+        throttle keeps last-commit <= W/2, so hi > last - W)."""
+        row = np.where(self.h_mask[g], self.h_commit[g], 0)
+        s = int(row.argmax())
+        return s, int(self.applied[g]), int(row[s])
+
+    def _collect_committed_confs(self) -> List[Tuple[int, int, int]]:
+        """Scan newly committed spans for conf payloads WITHOUT applying —
+        their mask flips must be in the same durable record as the round
+        that commits them."""
+        out = []
+        gc = self._group_commit()
+        for g in np.nonzero(gc > self.applied)[0]:
+            s, lo, hi = self._committed_span(int(g))
+            for i in range(lo + 1, hi + 1):
+                t = int(self.h_ring[g, s, i % self.cfg.window])
+                payload = self.payloads.get((int(g), i, t))
+                if payload and payload[0] == P_CONF:
+                    d = json.loads(payload[1:].decode())
+                    op = CONF_ADD if d["op"] == "add" else CONF_REMOVE
+                    out.append((int(g), d["slot"], op))
+        return out
+
+    def _apply_committed(self, trigger: bool, hist=None) -> None:
+        W = self.cfg.window
+        gc = self._group_commit()
+        changed = np.nonzero(gc > self.applied)[0]
+        for g in changed:
+            g = int(g)
+            s, lo, hi = self._committed_span(g)
+            for i in range(lo + 1, hi + 1):
+                if i > self.h_last[g, s] - W:
+                    t = int(self.h_ring[g, s, i % W])
+                elif hist is not None:
+                    t = hist.get((g, i))
+                    if t is None:
+                        log.error("engine: no term for committed entry "
+                                  "g=%d i=%d during restore", g, i)
+                        continue
+                else:
+                    # Live path: unreachable (admission throttle bounds the
+                    # span within the ring); refusing beats misapplying.
+                    log.error("engine: apply index %d below ring window of "
+                              "g=%d slot=%d (last=%d)", i, g, s,
+                              self.h_last[g, s])
+                    continue
+                payload = self.payloads.get((g, i, t))
+                if payload is None:
+                    continue  # leader no-op
+                if payload[0] == P_REQ:
+                    r = Request.decode(payload[1:])
+                    try:
+                        result = self._apply_request(g, r)
+                    except errors.EtcdError as err:
+                        result = err
+                    if trigger:
+                        self.wait.trigger(r.id, result)
+                elif payload[0] == P_CONF:
+                    d = json.loads(payload[1:].decode())
+                    self._apply_conf(g, d["op"], d["slot"])
+                    if trigger:
+                        self.wait.trigger(
+                            d["id"],
+                            [int(x) for x in np.nonzero(self.h_mask[g])[0]])
+            self.applied[g] = hi
+
+    def _apply_request(self, g: int, r: Request):
+        """Deterministic request->store mapping (reference applyRequest
+        server.go:766-820), against the group's own tenant store."""
+        st = self.store(g)
+        exp = r.expiration
+        if r.method == METHOD_POST:
+            return st.create(r.path, is_dir=r.dir, value=r.val, unique=True,
+                             expire_time=exp)
+        if r.method == METHOD_PUT:
+            if r.refresh:
+                return st.update(r.path, None, exp, refresh=True)
+            if r.prev_exist is not None:
+                if r.prev_exist:
+                    if r.prev_index or r.prev_value:
+                        return st.compare_and_swap(r.path, r.prev_value,
+                                                   r.prev_index, r.val, exp)
+                    return st.update(r.path, r.val, exp)
+                return st.create(r.path, is_dir=r.dir, value=r.val,
+                                 expire_time=exp)
+            if r.prev_index or r.prev_value:
+                return st.compare_and_swap(r.path, r.prev_value,
+                                           r.prev_index, r.val, exp)
+            return st.set(r.path, is_dir=r.dir, value=r.val, expire_time=exp)
+        if r.method == METHOD_DELETE:
+            if r.prev_index or r.prev_value:
+                return st.compare_and_delete(r.path, r.prev_value,
+                                             r.prev_index)
+            return st.delete(r.path, is_dir=r.dir, recursive=r.recursive)
+        if r.method == METHOD_QGET:
+            return st.get(r.path, r.recursive, r.sorted)
+        if r.method == METHOD_SYNC:
+            st.delete_expired_keys(r.time)
+            return None
+        raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                               cause=f"bad method {r.method}")
+
+    # ------------------------------------------------------------------
+    # host surgery: conf changes + snapshot install
+    # ------------------------------------------------------------------
+
+    def _apply_conf(self, g: int, op: str, slot: int) -> None:
+        """Flip a membership bit at a committed boundary and reset the
+        affected progress/vote columns (reference raft.go addNode/
+        removeNode + multinode.go:181-218)."""
+        jnp = self._jnp
+        add = (op == "add")
+        self.h_mask[g, slot] = add
+        mask = jnp.asarray(self.h_mask)
+
+        st = self.st
+        if add:
+            # Fresh empty follower state in the slot.
+            def zero_at(a):
+                arr = np.asarray(a).copy()
+                arr[g, slot] = 0
+                return jnp.asarray(arr)
+
+            ring = np.asarray(st.log_term).copy()
+            ring[g, slot] = 0
+            nxt = np.asarray(st.next).copy()
+            nxt[g, :, slot] = 1        # every potential leader probes from 1
+            match = np.asarray(st.match).copy()
+            match[g, :, slot] = 0
+            prs = np.asarray(st.pr_state).copy()
+            prs[g, :, slot] = 0        # PR_PROBE
+            paused = np.asarray(st.paused).copy()
+            paused[g, :, slot] = False
+            votes = np.asarray(st.votes).copy()
+            votes[g, :, slot] = 0
+            self.st = st._replace(
+                peer_mask=mask,
+                term=zero_at(st.term), vote=zero_at(st.vote),
+                commit=zero_at(st.commit), lead=zero_at(st.lead),
+                state=zero_at(st.state), elapsed=zero_at(st.elapsed),
+                last_index=zero_at(st.last_index),
+                log_term=jnp.asarray(ring), next=jnp.asarray(nxt),
+                match=jnp.asarray(match), pr_state=jnp.asarray(prs),
+                paused=jnp.asarray(paused), votes=jnp.asarray(votes))
+            self.h_ring[g, slot] = 0
+            self.h_last[g, slot] = 0
+            self.h_term[g, slot] = 0
+            self.h_vote[g, slot] = 0
+            self.h_commit[g, slot] = 0
+            self.h_state[g, slot] = 0
+        else:
+            # Freeze the removed slot as an inert follower so a stale
+            # LEADER row can never win leader_slot() again.
+            stat = np.asarray(st.state).copy()
+            stat[g, slot] = 0
+            lead = np.asarray(st.lead).copy()
+            lead[g, slot] = 0
+            self.st = st._replace(peer_mask=mask, state=jnp.asarray(stat),
+                                  lead=jnp.asarray(lead))
+            self.h_state[g, slot] = 0
+
+    def _service_need_host(self, need_host: np.ndarray) -> None:
+        """Consume need_host flags: for each flagged group with a live
+        leader, snapshot-install every active follower whose needed entries
+        fell below the leader's ring window (the host side of MsgSnap,
+        reference raft.go:246-260 + etcdserver snapshot catch-up §3.5)."""
+        jax, jnp = self._jax, self._jnp
+        st = self.st
+        W = self.cfg.window
+        flagged = np.nonzero(need_host.any(axis=1))[0]
+        if not len(flagged):
+            return
+        nxt = np.asarray(st.next).copy()
+        match = np.asarray(st.match).copy()
+        prs = np.asarray(st.pr_state).copy()
+        paused = np.asarray(st.paused).copy()
+        term = self.h_term.copy()
+        vote = self.h_vote.copy()
+        commit = self.h_commit.copy()
+        lastv = self.h_last.copy()
+        ring = self.h_ring.copy()
+        lead = np.asarray(st.lead).copy()
+        stat = self.h_state.copy()
+        elapsed = np.asarray(st.elapsed).copy()
+        touched = False
+        for g in flagged:
+            g = int(g)
+            s = self.leader_slot(g)
+            if s < 0:
+                continue
+            c = int(commit[g, s])
+            for f in np.nonzero(self.h_mask[g])[0]:
+                f = int(f)
+                if f == s:
+                    continue
+                # Lagging = the kernel's need_snap condition: entries from
+                # next are no longer resolvable from the leader's ring
+                # (next <= last - W; see kernel ents_ok/sendable).
+                if nxt[g, s, f] > lastv[g, s] - W:
+                    continue  # still reachable by appends
+                if term[g, f] > term[g, s]:
+                    continue  # follower is ahead in term; let raft sort it
+                log.info("engine: snapshot-install g=%d slot=%d from "
+                         "leader=%d commit=%d", g, f, s, c)
+                if term[g, f] < term[g, s]:
+                    vote[g, f] = 0
+                term[g, f] = term[g, s]
+                # Copy the leader's ring, but zero slots holding leader
+                # entries ABOVE the install point: on the follower those
+                # positions alias indices c-W..c and would otherwise carry
+                # wrong terms (the device never reads them below commit,
+                # but the WAL ring-diff would record the junk).
+                row = ring[g, s].copy()
+                l_s = int(lastv[g, s])
+                for w in range(W):
+                    if l_s - ((l_s - w) % W) > c:
+                        row[w] = 0
+                ring[g, f] = row
+                lastv[g, f] = c
+                commit[g, f] = c
+                stat[g, f] = 0
+                lead[g, f] = s + 1
+                elapsed[g, f] = 0
+                match[g, s, f] = c
+                nxt[g, s, f] = c + 1
+                prs[g, s, f] = 1       # PR_REPLICATE
+                paused[g, s, f] = False
+                touched = True
+        nh = np.zeros_like(need_host)
+        if touched:
+            self.st = st._replace(
+                term=jnp.asarray(term), vote=jnp.asarray(vote),
+                commit=jnp.asarray(commit), last_index=jnp.asarray(lastv),
+                log_term=jnp.asarray(ring), lead=jnp.asarray(lead),
+                state=jnp.asarray(stat), elapsed=jnp.asarray(elapsed),
+                match=jnp.asarray(match), next=jnp.asarray(nxt),
+                pr_state=jnp.asarray(prs), paused=jnp.asarray(paused),
+                need_host=jnp.asarray(nh))
+            # NOTE: the h_* mirrors deliberately KEEP their pre-surgery
+            # values — the next round's WAL diff then records the install's
+            # term/commit/ring/last changes, making it durable.
+        else:
+            self.st = st._replace(need_host=jnp.asarray(nh))
+
+    # ------------------------------------------------------------------
+    # checkpoint
+    # ------------------------------------------------------------------
+
+    def _checkpoint(self) -> None:
+        import base64 as _b64
+        state = {
+            "round": self.round_no - 1,
+            "term": np_b64(self.h_term), "vote": np_b64(self.h_vote),
+            "commit": np_b64(self.h_commit), "last": np_b64(self.h_last),
+            "ring": np_b64(self.h_ring), "mask": np_b64(self.h_mask),
+            "applied": np_b64(self.applied),
+            "stores": {str(g): s.save().decode()
+                       for g, s in self._stores.items()},
+            "payloads": [
+                (g, i, t, _b64.b64encode(p).decode())
+                for (g, i, t), p in self.payloads.items()
+                if i > self.applied[g]],
+        }
+        self.wal.save_checkpoint(self.round_no - 1, state)
+
+    def _gc_payloads(self) -> None:
+        dead = [k for k in self.payloads if k[1] <= self.applied[k[0]]]
+        for k in dead:
+            del self.payloads[k]
