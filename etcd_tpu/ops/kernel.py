@@ -114,6 +114,7 @@ def _append_noop_and_lead(st: GroupState, cfg: KernelConfig,
         next=_where(win[..., None], new_last[..., None], st.next),
         pr_state=_where(win[..., None], PR_PROBE, st.pr_state),
         paused=_where(win[..., None], False, st.paused),
+        ack_age=_where(win[..., None], 0, st.ack_age),
     )
     return _set_self_progress(st)
 
@@ -290,8 +291,23 @@ def _step_msgs_from(st: GroupState, cfg: KernelConfig, q: int,
     write_j = do_append[..., None] & valid_j & (idx_j >= ci[..., None])
     st = _write_terms(st, cfg, idx_j, ent_terms, write_j)
     lastnewi = mindex + mnent
+    old_last = st.last_index
     st = st._replace(
         last_index=_where(do_append, lastnewi, st.last_index))
+    # A SHRINKING truncation strands ring slots: the discarded entries'
+    # slots now alias indices W lower, which fall back INSIDE the valid
+    # window — but those lower entries' true terms were overwritten long
+    # ago. Zero the stranded slots so stale terms can never be read as
+    # live ones (0 = unresolvable sentinel). The device itself only reads
+    # terms at indices >= commit (all strands are strictly below commit:
+    # the admission throttle keeps last-commit < W), but the host engine
+    # diffs the whole ring into its WAL and must not record junk.
+    shrink = do_append & (old_last > lastnewi)
+    w_idx = jnp.arange(cfg.window, dtype=jnp.int32)[None, None, :]
+    i_w = old_last[..., None] - jnp.mod(old_last[..., None] - w_idx,
+                                        cfg.window)
+    strand = shrink[..., None] & (i_w > lastnewi[..., None])
+    st = st._replace(log_term=jnp.where(strand, 0, st.log_term))
     new_commit = jnp.maximum(st.commit,
                              jnp.minimum(mcommit, lastnewi))
     st = st._replace(commit=_where(match_ok, new_commit, st.commit))
@@ -327,6 +343,10 @@ def _step_msgs_from(st: GroupState, cfg: KernelConfig, q: int,
         next=st.next.at[:, :, q].set(next_q),
         pr_state=st.pr_state.at[:, :, q].set(pr_q),
         paused=st.paused.at[:, :, q].set(paused_q),
+        # Any append response (accept or reject) is replication-liveness
+        # evidence from this target.
+        ack_age=st.ack_age.at[:, :, q].set(
+            _where(ar, 0, st.ack_age[:, :, q])),
     )
 
     # -- MsgHeartbeat (reference handleHeartbeat raft.go:666-669) -----------
@@ -340,7 +360,26 @@ def _step_msgs_from(st: GroupState, cfg: KernelConfig, q: int,
     )
     resp = _stage(resp, h, M_HB_RESP, st.term)
 
-    # -- MsgHeartbeatResp: gap-driven sends + BEAT-resume make it a no-op ---
+    # -- MsgHeartbeatResp: staleness-driven retransmission (reference
+    #    stepLeader MsgHeartbeatResp -> sendAppend, raft.go:547-551).
+    #    Gap-driven sends make the ordinary case a no-op, but appends can
+    #    be lost (network drops, outbox slot collisions) with next already
+    #    optimistically bumped — then nothing ever resends: match freezes
+    #    whether unacked pinned at the flow window or the group just went
+    #    idle. A heartbeat response while the target's append responses
+    #    have been silent for > 2 heartbeat intervals pulls next back to
+    #    match+1 so the gap-driven sender retransmits the window. The age
+    #    gate keeps steady-state traffic (acks merely in flight) free of
+    #    duplicate sends. --
+    hrs = live & is_l & (mtype == M_HB_RESP)
+    match_h = st.match[:, :, q]
+    next_h = st.next[:, :, q]
+    stale = (hrs & (st.pr_state[:, :, q] == PR_REPLICATE)
+             & (match_h < st.last_index)
+             & (st.ack_age[:, :, q] > 2 * cfg.heartbeat_tick + 2))
+    st = st._replace(
+        next=st.next.at[:, :, q].set(
+            _where(stale, match_h + 1, next_h)))
     return st, resp
 
 
@@ -572,6 +611,8 @@ def step(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     """
     active = active_mask(st)
     P = st.term.shape[1]
+    # Age every target's silence counter (clamped; see ack_age docs).
+    st = st._replace(ack_age=jnp.minimum(st.ack_age + 1, 1 << 20))
 
     def do_tick(st):
         return _tick(st, cfg, active)

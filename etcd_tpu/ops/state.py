@@ -92,6 +92,11 @@ class GroupState(NamedTuple):
     next: jax.Array          # (G, P, P) int32
     pr_state: jax.Array      # (G, P, P) int32 in {PR_PROBE, PR_REPLICATE}
     paused: jax.Array        # (G, P, P) bool (probe in-flight pause)
+    # Rounds since the last append response from each target — the staleness
+    # signal behind heartbeat-response retransmission (the dense form of the
+    # reference's MsgHeartbeatResp -> sendAppend liveness rule,
+    # raft.go:547-551).
+    ack_age: jax.Array       # (G, P, P) int32
 
     # Candidate vote tally (reference raft.votes): 0 unknown / 1 granted /
     # 2 rejected, per voter slot:
@@ -166,6 +171,7 @@ def init_state(cfg: KernelConfig, n_peers=None,
         next=jnp.ones((G, P, P), jnp.int32),
         pr_state=zeros_gpp(),
         paused=jnp.zeros((G, P, P), bool),
+        ack_age=zeros_gpp(),
         votes=zeros_gpp(),
         peer_mask=jnp.asarray(mask0),
         need_host=jnp.zeros((G, P), bool),

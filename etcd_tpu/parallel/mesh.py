@@ -48,7 +48,8 @@ def state_sharding(mesh: Mesh) -> GroupState:
     return GroupState(
         term=gp, vote=gp, commit=gp, lead=gp, state=gp, elapsed=gp, prng=gp,
         log_term=gpx, last_index=gp,
-        match=gpx, next=gpx, pr_state=gpx, paused=gpx, votes=gpx,
+        match=gpx, next=gpx, pr_state=gpx, paused=gpx, ack_age=gpx,
+        votes=gpx,
         peer_mask=gp, need_host=gp,
     )
 

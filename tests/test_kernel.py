@@ -287,3 +287,51 @@ def test_step_is_jit_stable():
     st, out2 = kernel.step(cfg, st, kernel.route_local(out), zero, zero,
                            jnp.asarray(True))
     assert out2.shape == (cfg.groups, cfg.peers, cfg.peers, cfg.fields)
+
+
+def test_lost_appends_retransmitted_via_heartbeat_resp():
+    """Appends to one follower are dropped while next is optimistically
+    bumped past them; once the drop heals, the leader must recover via the
+    heartbeat-response staleness rule (reference stepLeader MsgHeartbeatResp
+    -> sendAppend, raft.go:547-551) — with no new proposals to kick the
+    gap-driven sender."""
+    cfg = KernelConfig(groups=2, peers=3, window=16, max_ents=2,
+                       heartbeat_tick=2)
+    st = init_state(cfg, stagger=True)
+    st, inbox = run_rounds(cfg, st, 8)
+    slots = leader_slot(st)
+    assert (slots >= 0).all()
+    g = np.arange(cfg.groups)
+    victim = (slots + 1) % cfg.peers
+
+    from etcd_tpu.ops.state import F_TYPE, M_APP
+
+    def drop_apps(r, inbox):
+        arr = np.array(inbox)
+        is_app = arr[g, victim, :, F_TYPE] == M_APP
+        arr[g, victim, :, :] = np.where(is_app[..., None], 0,
+                                        arr[g, victim, :, :])
+        return jnp.asarray(arr)
+
+    def props(r, cur):
+        return (jnp.full(cfg.groups, 2, jnp.int32),
+                jnp.asarray(slots, jnp.int32))
+
+    # Propose while appends to the victim vanish (acks never come back
+    # because the appends never arrive; heartbeats still flow). Few enough
+    # entries that the victim stays within the leader's ring window —
+    # beyond it, catch-up is the host snapshot-install path (engine tests).
+    st, inbox = run_rounds(cfg, st, 3, inbox=inbox, props=props,
+                           drop=drop_apps)
+    last = np.asarray(st.last_index)[g, slots]
+    match_v = np.asarray(st.match)[g, slots, victim]
+    assert (match_v < last).all(), "victim should be behind"
+
+    # Heal, but propose NOTHING more: only the staleness rule can recover.
+    st, inbox = run_rounds(cfg, st, 25, inbox=inbox)
+    match_v = np.asarray(st.match)[g, slots, victim]
+    last = np.asarray(st.last_index)[g, slots]
+    assert (match_v == last).all(), (
+        "victim not caught up after heal", match_v, last)
+    commit = np.asarray(st.commit)[g, victim]
+    assert (commit == last).all(), (commit, last)
