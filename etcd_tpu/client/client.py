@@ -46,9 +46,12 @@ class Client:
 
     def __init__(self, endpoints: Sequence[str], timeout: float = 5.0,
                  username: str = "", password: str = "",
-                 proxy: str = "") -> None:
+                 proxy: str = "", tls=None) -> None:
         """proxy: optional HTTP proxy URL all requests are routed through
-        (reference discovery newProxyFunc + http.Transport.Proxy)."""
+        (reference discovery newProxyFunc + http.Transport.Proxy).
+        tls: a utils.tlsutil.TLSInfo (or ready ssl.SSLContext) for
+        https:// endpoints — CA verification + optional client cert
+        (reference client TLS flags, etcdmain/config.go:166-180)."""
         if not endpoints:
             raise ValueError("at least one endpoint required")
         self._lock = threading.Lock()
@@ -59,6 +62,12 @@ class Client:
         if proxy and "://" not in proxy:
             proxy = "http://" + proxy
         self.proxy = proxy
+        import ssl as _ssl
+        if tls is None or isinstance(tls, _ssl.SSLContext):
+            self.tls_context = tls
+        else:
+            from etcd_tpu.utils.tlsutil import client_context_or_none
+            self.tls_context = client_context_or_none(tls)
 
     @property
     def endpoints(self) -> List[str]:
@@ -104,7 +113,8 @@ class Client:
                 f"{self.username}:{self.password}".encode()).decode()
             r.add_header("Authorization", f"Basic {cred}")
         try:
-            with urllib.request.urlopen(r, timeout=timeout) as resp:
+            with urllib.request.urlopen(r, timeout=timeout,
+                                        context=self.tls_context) as resp:
                 return HttpResponse(resp.status, dict(resp.headers),
                                     resp.read())
         except urllib.error.HTTPError as e:

@@ -40,6 +40,13 @@ class EtcdConfig:
     request_timeout: float = 5.0
     initial_cluster_state: str = "new"   # "new" | "existing" (join)
     force_new_cluster: bool = False
+    cors: Sequence[str] = ()             # client-listener CORS origins
+    # TLS (reference etcdmain/etcd.go:133-180 listener setup +
+    # pkg/transport): client_tls secures the client listeners; peer_tls
+    # secures BOTH the peer listeners and the outgoing peer transport
+    # (mutual TLS when its ca_file/client_cert_auth are set).
+    client_tls: object = None            # Optional[tlsutil.TLSInfo]
+    peer_tls: object = None              # Optional[tlsutil.TLSInfo]
 
 
 class Etcd:
@@ -69,7 +76,13 @@ class Etcd:
             new_cluster=cfg.initial_cluster_state != "existing",
             force_new_cluster=cfg.force_new_cluster)
 
-        self.transport = HttpTransport()
+        peer_tls = cfg.peer_tls if (cfg.peer_tls is not None
+                                    and not cfg.peer_tls.empty()) else None
+        client_tls = cfg.client_tls if (cfg.client_tls is not None
+                                        and not cfg.client_tls.empty()) \
+            else None
+        self.transport = HttpTransport(
+            tls_context=peer_tls.client_context() if peer_tls else None)
         self.server = EtcdServer(scfg, self.transport)
 
         # Peer listener(s) — one per peer URL (reference etcd.go:133-160).
@@ -79,7 +92,9 @@ class Etcd:
             router = Router()
             papi.install(router)
             host, port = _listen_addr(url)
-            self.peer_http.append(HttpServer(host, port, router))
+            self.peer_http.append(HttpServer(
+                host, port, router,
+                tls_context=peer_tls.server_context() if peer_tls else None))
 
         # Client listener(s) (reference etcd.go:163-180,211-229), with the
         # v2 security gate + /v2/security routes wired in.
@@ -92,7 +107,12 @@ class Etcd:
             self.client_api.install(router)
             self.security.install(router)
             host, port = _listen_addr(url)
-            self.client_http.append(HttpServer(host, port, router))
+            # CORS wraps only the CLIENT mux (reference etcd.go:218-229).
+            self.client_http.append(
+                HttpServer(host, port, router,
+                           cors=set(cfg.cors) if cfg.cors else None,
+                           tls_context=(client_tls.server_context()
+                                        if client_tls else None)))
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -36,6 +36,9 @@ class Ctx:
                                  keep_blank_values=True).items():
                 self._values[k] = v + self._values.get(k, [])
         self._streaming = False
+        # Extra headers injected into every response (CORS); set by the
+        # server before dispatch.
+        self.extra_headers: Dict[str, str] = {}
 
     # -- inputs -------------------------------------------------------------
 
@@ -62,6 +65,8 @@ class Ctx:
         h.send_response(status)
         h.send_header("Content-Type", content_type)
         h.send_header("Content-Length", str(len(body)))
+        for k, v in self.extra_headers.items():
+            h.send_header(k, v)
         for k, v in (headers or {}).items():
             h.send_header(k, v)
         h.end_headers()
@@ -81,6 +86,8 @@ class Ctx:
         h.send_response(status)
         h.send_header("Content-Type", content_type)
         h.send_header("Transfer-Encoding", "chunked")
+        for k, v in self.extra_headers.items():
+            h.send_header(k, v)
         for k, v in (headers or {}).items():
             h.send_header(k, v)
         h.end_headers()
@@ -152,8 +159,12 @@ class HttpServer:
     never block shutdown."""
 
     def __init__(self, host: str, port: int, router: Router,
-                 server_version: str = "etcd-tpu") -> None:
+                 server_version: str = "etcd-tpu",
+                 cors: Optional[set] = None, tls_context=None) -> None:
         self.router = router
+        # CORS origin whitelist ("*" = any); None disables CORS handling
+        # (reference pkg/cors/cors.go CORSInfo + CORSHandler).
+        self.cors = set(cors) if cors else None
 
         outer = self
 
@@ -164,6 +175,14 @@ class HttpServer:
             def log_message(self, fmt, *args):  # silence stderr chatter
                 pass
 
+            def setup(self):
+                # TLS handshakes run here, in the per-connection handler
+                # thread — never in the accept loop, where a slow client
+                # would head-of-line block every other connection.
+                if outer._tls:
+                    self.request.do_handshake()
+                super().setup()
+
             def _run(self, method: str) -> None:
                 try:
                     parts = urlsplit(self.path)
@@ -172,6 +191,25 @@ class HttpServer:
                     ctx = Ctx(self, method, unquote(parts.path),
                               parse_qs(parts.query, keep_blank_values=True),
                               body)
+                    if outer.cors is not None:
+                        # reference CORSHandler.ServeHTTP: header on every
+                        # allowed-origin response; OPTIONS answered 200.
+                        if "*" in outer.cors:
+                            allow = "*"
+                        else:
+                            origin = self.headers.get("Origin", "")
+                            allow = origin if origin in outer.cors else None
+                        if allow is not None:
+                            ctx.extra_headers = {
+                                "Access-Control-Allow-Methods":
+                                    "POST, GET, OPTIONS, PUT, DELETE",
+                                "Access-Control-Allow-Origin": allow,
+                                "Access-Control-Allow-Headers":
+                                    "accept, content-type",
+                            }
+                        if method == "OPTIONS":
+                            ctx.send(200)
+                            return
                     if not outer.router.dispatch(ctx):
                         ctx.send(404, b"404 page not found\n")
                     if ctx._streaming:
@@ -199,6 +237,9 @@ class HttpServer:
 
             def do_HEAD(self):
                 self._run("HEAD")
+
+            def do_OPTIONS(self):
+                self._run("OPTIONS")
 
         class _Server(ThreadingHTTPServer):
             """Tracks live connections so stop() can sever keep-alive
@@ -236,6 +277,15 @@ class HttpServer:
                         pass
 
         self._httpd = _Server((host, port), _Handler)
+        self._scheme = "https" if tls_context is not None else "http"
+        self._tls = tls_context is not None
+        if tls_context is not None:
+            # TLS listener (reference pkg/transport NewTLSListener,
+            # listener.go:60-80): wrap the accept socket; per-connection
+            # handshakes happen in the handler threads.
+            self._httpd.socket = tls_context.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -245,7 +295,7 @@ class HttpServer:
     @property
     def url(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        return f"{self._scheme}://{host}:{port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -79,6 +79,15 @@ class MainConfig:
     cors: Tuple[str, ...] = ()
     force_new_cluster: bool = False
     debug: bool = False
+    # TLS (reference config.go:166-180).
+    cert_file: str = ""
+    key_file: str = ""
+    ca_file: str = ""
+    client_cert_auth: bool = False
+    peer_cert_file: str = ""
+    peer_key_file: str = ""
+    peer_ca_file: str = ""
+    peer_client_cert_auth: bool = False
 
     @property
     def is_proxy(self) -> bool:
@@ -139,6 +148,18 @@ _FLAGS = [
     ("force-new-cluster", bool, False,
      "Force to create a new one-member cluster"),
     ("debug", bool, False, "Enable debug output to the logs"),
+    # Client TLS (reference etcdmain/config.go:166-173 security flags).
+    ("cert-file", str, "", "Path to the client server TLS cert file"),
+    ("key-file", str, "", "Path to the client server TLS key file"),
+    ("ca-file", str, "", "Path to the client server TLS trusted CA file"),
+    ("client-cert-auth", bool, False,
+     "Enable client cert authentication"),
+    # Peer TLS (reference etcdmain/config.go:174-180).
+    ("peer-cert-file", str, "", "Path to the peer server TLS cert file"),
+    ("peer-key-file", str, "", "Path to the peer server TLS key file"),
+    ("peer-ca-file", str, "", "Path to the peer server TLS trusted CA file"),
+    ("peer-client-cert-auth", bool, False,
+     "Enable peer client cert authentication"),
 ]
 
 

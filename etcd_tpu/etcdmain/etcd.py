@@ -19,6 +19,7 @@ from typing import List, Optional, Sequence
 
 from etcd_tpu.embed import Etcd, EtcdConfig
 from etcd_tpu.etcdhttp.web import HttpServer, Router
+from etcd_tpu.utils.tlsutil import TLSInfo
 from etcd_tpu.etcdmain.config import (ConfigError, MainConfig,
                                       PROXY_READONLY, parse_args)
 from etcd_tpu.proxy import Director, ReverseProxy, fetch_cluster_urls, readonly
@@ -73,6 +74,14 @@ def start_etcd(cfg: MainConfig) -> Etcd:
         election_ticks=cfg.election_ticks,
         initial_cluster_state=cfg.initial_cluster_state,
         force_new_cluster=cfg.force_new_cluster,
+        cors=cfg.cors,
+        client_tls=TLSInfo(cert_file=cfg.cert_file, key_file=cfg.key_file,
+                           ca_file=cfg.ca_file,
+                           client_cert_auth=cfg.client_cert_auth),
+        peer_tls=TLSInfo(cert_file=cfg.peer_cert_file,
+                         key_file=cfg.peer_key_file,
+                         ca_file=cfg.peer_ca_file,
+                         client_cert_auth=cfg.peer_client_cert_auth),
     )
     e = Etcd(ecfg)
     e.start()
@@ -112,12 +121,22 @@ class ProxyServer:
         rp = ReverseProxy(self.director)
         handler = readonly(rp.handle) if cfg.is_readonly_proxy else rp.handle
         self.http: List[HttpServer] = []
+        # The proxy's client listener honors the same TLS + CORS flags as a
+        # member's (reference startProxy wires the client TLSInfo,
+        # etcdmain/etcd.go:234-335).
+        client_tls = TLSInfo(cert_file=cfg.cert_file, key_file=cfg.key_file,
+                             ca_file=cfg.ca_file,
+                             client_cert_auth=cfg.client_cert_auth)
         for url in cfg.listen_client_urls:
             from etcd_tpu.embed import _listen_addr
             host, port = _listen_addr(url)
             router = Router()
             router.add("/", handler)
-            self.http.append(HttpServer(host, port, router))
+            self.http.append(HttpServer(
+                host, port, router,
+                cors=set(cfg.cors) if cfg.cors else None,
+                tls_context=(client_tls.server_context()
+                             if not client_tls.empty() else None)))
 
     def _refresh_urls(self) -> List[str]:
         client_urls, peer_urls = fetch_cluster_urls(self._peer_urls)

@@ -1,0 +1,5 @@
+from etcd_tpu.migrate.etcd4 import (decode_config4, decode_log4,
+                                    decode_latest_snapshot4, migrate_4_to_2)
+
+__all__ = ["decode_config4", "decode_log4", "decode_latest_snapshot4",
+           "migrate_4_to_2"]

@@ -37,19 +37,26 @@ _RETRY_INTERVAL = 0.05    # back-off after a failed POST
 
 
 class _Conn:
-    """One keep-alive HTTP connection to a peer URL."""
+    """One keep-alive HTTP(S) connection to a peer URL."""
 
-    def __init__(self, url: str, timeout: float) -> None:
+    def __init__(self, url: str, timeout: float, tls_context=None) -> None:
         u = urlsplit(url)
         self.host = u.hostname or "localhost"
         self.port = u.port or (443 if u.scheme == "https" else 80)
+        self.https = u.scheme == "https"
+        self.tls_context = tls_context
         self.timeout = timeout
         self._c: Optional[http.client.HTTPConnection] = None
 
     def post(self, path: str, body: bytes, headers: Dict[str, str]) -> int:
         if self._c is None:
-            self._c = http.client.HTTPConnection(self.host, self.port,
-                                                 timeout=self.timeout)
+            if self.https:
+                self._c = http.client.HTTPSConnection(
+                    self.host, self.port, timeout=self.timeout,
+                    context=self.tls_context)
+            else:
+                self._c = http.client.HTTPConnection(self.host, self.port,
+                                                     timeout=self.timeout)
         try:
             self._c.request("POST", path, body=body, headers=headers)
             resp = self._c.getresponse()
@@ -87,8 +94,8 @@ class _Peer:
             threading.Thread(target=self._snap_loop, daemon=True,
                              name=f"rafthttp-snap-{pid:x}"),
         ]
-        self._conn = _Conn(self.urls[0], t.dial_timeout)
-        self._snap_conn = _Conn(self.urls[0], t.snap_timeout)
+        self._conn = _Conn(self.urls[0], t.dial_timeout, t.tls_context)
+        self._snap_conn = _Conn(self.urls[0], t.snap_timeout, t.tls_context)
         for th in self._threads:
             th.start()
 
@@ -127,7 +134,8 @@ class _Peer:
 
     def _rotate_url(self) -> None:
         self._url_idx = (self._url_idx + 1) % max(len(self.urls), 1)
-        self._conn = _Conn(self._pick_url(), self.t.dial_timeout)
+        self._conn = _Conn(self._pick_url(), self.t.dial_timeout,
+                           self.t.tls_context)
 
     def _send_loop(self) -> None:
         while not self._stop.is_set():
@@ -181,7 +189,8 @@ class _Peer:
                 status = -1
             ok = status in (200, 204)
             if not ok:
-                self._snap_conn = _Conn(self._pick_url(), self.t.snap_timeout)
+                self._snap_conn = _Conn(self._pick_url(), self.t.snap_timeout,
+                                        self.t.tls_context)
             self.t._report_snapshot(self.id, ok)
 
 
@@ -190,9 +199,12 @@ class HttpTransport(Transporter):
     (for feedback + stats) via bind(); EtcdServer does this automatically."""
 
     def __init__(self, dial_timeout: float = 1.0,
-                 snap_timeout: float = 30.0) -> None:
+                 snap_timeout: float = 30.0, tls_context=None) -> None:
         self.dial_timeout = dial_timeout
         self.snap_timeout = snap_timeout
+        # ssl.SSLContext for https:// peer URLs (reference peer TLS,
+        # pkg/transport.NewTransport + etcdmain/etcd.go:133-160).
+        self.tls_context = tls_context
         self._peers: Dict[int, _Peer] = {}
         self._remotes: Dict[int, _Peer] = {}  # catch-up-only (remote.go)
         self._lock = threading.Lock()
@@ -201,6 +213,28 @@ class HttpTransport(Transporter):
 
     def bind(self, server) -> None:
         self._server = server
+
+    def member_version(self, mid: int, peer_urls: Iterable[str]):
+        """GET /version from the member's peer listener with THIS
+        transport's TLS context — a TLS-secured cluster must negotiate its
+        version over the same mutual-TLS channel its raft traffic uses
+        (reference getVersions uses the peer transport,
+        cluster_util.go:118-137)."""
+        import json as _json
+        import ssl as _ssl
+        import urllib.request
+        for u in peer_urls:
+            if not u.startswith(("http://", "https://")):
+                continue
+            try:
+                with urllib.request.urlopen(
+                        u.rstrip("/") + "/version", timeout=0.5,
+                        context=self.tls_context if u.startswith("https://")
+                        else None) as resp:
+                    return _json.loads(resp.read()).get("etcdserver")
+            except Exception:
+                continue
+        return None
 
     # -- Transporter ---------------------------------------------------------
 

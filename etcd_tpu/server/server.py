@@ -74,6 +74,10 @@ class ServerConfig:
     # peers in initial_cluster instead of founding (reference
     # server.go:194-217 `!haveWAL && !cfg.NewCluster`).
     new_cluster: bool = True
+    # Continuous cluster-version negotiation cadence (reference
+    # monitorVersionInterval, server.go:933). Winning leadership forces an
+    # immediate round, so the initial negotiation never waits on this.
+    version_monitor_interval: float = 5.0
     # Disaster recovery: restart as a one-member cluster, rewriting
     # membership in the log (reference -force-new-cluster,
     # etcdserver/raft.go:266-315).
@@ -117,7 +121,15 @@ class EtcdServer:
         self._removed_self = False
         self._sync_elapsed = 0
         self.lead_elected_ev = threading.Event()
-        self._version_proposed = False
+        self._force_version_ev = threading.Event()  # reference forceVersionC
+        self._version_thread: Optional[threading.Thread] = None
+
+        # v0.4 data dirs auto-upgrade on boot (reference upgradeDataDir
+        # chain, etcdserver/storage.go:111-132 + server.go:181-187).
+        if not wal_exists(cfg.waldir):
+            from etcd_tpu.migrate import etcd4 as migrate4
+            if migrate4.is_v04_data_dir(cfg.data_dir):
+                migrate4.migrate_4_to_2(cfg.data_dir, cfg.name)
 
         if wal_exists(cfg.waldir):
             if cfg.force_new_cluster:
@@ -315,6 +327,10 @@ class EtcdServer:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"etcd-{self.cfg.name}")
         self._thread.start()
+        self._version_thread = threading.Thread(
+            target=self._monitor_versions, daemon=True,
+            name=f"etcd-{self.cfg.name}-vermon")
+        self._version_thread.start()
 
     def stop(self) -> None:
         self._stop_ev.set()
@@ -501,25 +517,82 @@ class EtcdServer:
                 self._stop_ev.set()
 
     def cluster_version(self) -> str:
-        """The negotiated cluster version served at /version (reference
-        monitorVersions server.go:933-973; minimal negotiation: the leader
-        proposes its own version once, members adopt the replicated value)."""
+        """The negotiated cluster version served at /version. Continuously
+        re-decided by the leader as the MIN of all members' server versions
+        (reference monitorVersions server.go:933-973 +
+        decideClusterVersion cluster_util.go:142-186)."""
         from etcd_tpu import version as ver
         return self.cluster.version() or ver.MIN_CLUSTER_VERSION
+
+    @staticmethod
+    def _ver_tuple(v: str):
+        return tuple(int(x) for x in v.split(".")[:3])
+
+    def _get_versions(self) -> Dict[int, Optional[str]]:
+        """Each member's server version via the peer transport (so TLS
+        clusters negotiate over the same mutual-TLS channel); None when
+        unreachable (reference getVersions cluster_util.go:118-137). Self
+        answers locally."""
+        from etcd_tpu import version as ver
+        out: Dict[int, Optional[str]] = {}
+        for m in self.cluster.members():
+            if m.id == self.id:
+                out[m.id] = ver.VERSION
+            else:
+                out[m.id] = self.transport.member_version(m.id, m.peer_urls)
+        return out
+
+    def _decide_cluster_version(self) -> Optional[str]:
+        """Min server version across members; None if any member's version
+        is unknown (reference decideClusterVersion)."""
+        vers = self._get_versions()
+        decided = None
+        for mid, v in vers.items():
+            if v is None:
+                return None
+            try:
+                vt = self._ver_tuple(v)
+            except ValueError:
+                return None
+            if decided is None or vt < self._ver_tuple(decided):
+                decided = v
+        return decided
+
+    def _monitor_versions(self) -> None:
+        """reference monitorVersions server.go:933-973: every interval (or
+        immediately on winning leadership), the leader re-decides the
+        cluster version and proposes an update when it rises — so mixed-
+        version clusters settle on the minimum and upgrades roll forward
+        only once every member has upgraded."""
+        from etcd_tpu import version as ver
+        while not self._stop_ev.is_set():
+            self._force_version_ev.wait(self.cfg.version_monitor_interval)
+            self._force_version_ev.clear()
+            if self._stop_ev.is_set():
+                return
+            if not self.is_leader():
+                continue
+            v = self._decide_cluster_version()
+            if v is not None:
+                v = ".".join(str(x) for x in self._ver_tuple(v)[:2]) + ".0"
+            cur = self.cluster.version()
+            target = None
+            if cur is None:
+                # 1. decided version if possible, 2. min cluster version.
+                target = v or ver.MIN_CLUSTER_VERSION
+            elif v is not None and self._ver_tuple(cur) < self._ver_tuple(v):
+                target = v
+            if target is not None:
+                r = Request(id=self.reqid.next(), method=METHOD_PUT,
+                            path=cl.CLUSTER_VERSION_KEY, val=target)
+                self._inq.put(("prop", (r.id, r.encode())))
 
     def _on_tick(self) -> None:
         if self.is_leader():
             self.stats.become_leader()
+            if not self.lead_elected_ev.is_set():
+                self._force_version_ev.set()   # negotiate immediately
             self.lead_elected_ev.set()
-            if not self._version_proposed and self.cluster.version() is None:
-                from etcd_tpu import version as ver
-                self._version_proposed = True
-                r = Request(id=self.reqid.next(), method=METHOD_PUT,
-                            path=cl.CLUSTER_VERSION_KEY, val=ver.VERSION)
-                try:
-                    self.node.propose(r.encode())
-                except ProposalDroppedError:
-                    self._version_proposed = False
             self._sync_elapsed += 1
             if (self._sync_elapsed >= self.cfg.sync_ticks):
                 self._sync_elapsed = 0

@@ -33,6 +33,13 @@ class Transporter:
     def update_peer(self, mid: int, urls: Iterable[str]) -> None:
         pass
 
+    def member_version(self, mid: int, peer_urls: Iterable[str]
+                       ) -> Optional[str]:
+        """The member's server version for cluster-version negotiation
+        (reference getVersions cluster_util.go:118-137), or None when
+        unreachable/unsupported."""
+        return None
+
     def stop(self) -> None:
         pass
 
@@ -131,6 +138,15 @@ class InMemoryTransport(Transporter):
                     self.report_snapshot(m.to, False)
             elif is_snap and self.report_snapshot is not None:
                 self.report_snapshot(m.to, True)
+
+    def member_version(self, mid: int, peer_urls: Iterable[str]
+                       ) -> Optional[str]:
+        # All members of an in-memory cluster are this process: same code,
+        # same version — reachable iff registered.
+        if mid in self.net._inboxes:
+            from etcd_tpu import version as ver
+            return ver.VERSION
+        return None
 
     # Pausable (reference transport.go:235-249).
     def pause(self) -> None:

@@ -370,3 +370,100 @@ class TestMeta:
     def test_404_paths(self, cluster):
         st, _, _ = curl(cluster, "GET", "/v2/bogus")
         assert st == 404
+
+
+# -- CORS (reference pkg/cors/cors.go via the client-listener wrap) ----------
+
+def test_cors_enforced(tmp_path):
+    pport, cport = free_ports(2)
+    cfg = EtcdConfig(
+        name="c0", data_dir=str(tmp_path / "c0"),
+        initial_cluster={"c0": [f"http://127.0.0.1:{pport}"]},
+        listen_client_urls=[f"http://127.0.0.1:{cport}"],
+        tick_ms=10, cors=["http://allowed.example"])
+    m = Etcd(cfg)
+    m.start()
+    try:
+        assert m.wait_leader(10)
+        base = m.client_urls[0]
+        # Allowed origin: headers present.
+        st, hdrs, _ = req("GET", base + "/version",
+                          headers={"Origin": "http://allowed.example"})
+        assert st == 200
+        assert hdrs.get("Access-Control-Allow-Origin") == \
+            "http://allowed.example"
+        assert "POST" in hdrs.get("Access-Control-Allow-Methods", "")
+        # Disallowed origin: no CORS headers (the browser blocks it).
+        st, hdrs, _ = req("GET", base + "/version",
+                          headers={"Origin": "http://evil.example"})
+        assert st == 200
+        assert "Access-Control-Allow-Origin" not in hdrs
+        # Preflight answers 200 immediately.
+        st, hdrs, _ = req("OPTIONS", base + "/v2/keys/x",
+                          headers={"Origin": "http://allowed.example"})
+        assert st == 200
+        assert hdrs.get("Access-Control-Allow-Origin") == \
+            "http://allowed.example"
+    finally:
+        m.stop()
+
+
+def test_cors_wildcard(tmp_path):
+    pport, cport = free_ports(2)
+    cfg = EtcdConfig(
+        name="cw", data_dir=str(tmp_path / "cw"),
+        initial_cluster={"cw": [f"http://127.0.0.1:{pport}"]},
+        listen_client_urls=[f"http://127.0.0.1:{cport}"],
+        tick_ms=10, cors=["*"])
+    m = Etcd(cfg)
+    m.start()
+    try:
+        assert m.wait_leader(10)
+        st, hdrs, _ = req("GET", m.client_urls[0] + "/version")
+        assert st == 200
+        assert hdrs.get("Access-Control-Allow-Origin") == "*"
+    finally:
+        m.stop()
+
+
+# -- continuous cluster-version negotiation (reference monitorVersions
+#    server.go:933-973 + decideClusterVersion cluster_util.go:142-186) ------
+
+def test_version_monitor_decides_min_and_upgrades(cluster):
+    """A live cluster negotiates the min member version; when every member
+    reports a higher version the monitor proposes the upgrade; it never
+    downgrades."""
+    import time as _t
+    lead = next(m for m in cluster if m.server.is_leader())
+    srv = lead.server
+    deadline = _t.time() + 10
+    while _t.time() < deadline and srv.cluster.version() is None:
+        _t.sleep(0.05)
+    assert srv.cluster_version() == "2.1.0"  # all members run 2.1.0
+
+    # Mixed versions: one member reports older -> decided = min = 2.0.x ->
+    # but 2.1.0 is already set and the monitor never downgrades.
+    orig = srv._get_versions
+    try:
+        srv._get_versions = lambda: {1: "2.1.0", 2: "2.0.5", 3: "2.1.0"}
+        assert srv._decide_cluster_version() == "2.0.5"
+        srv._force_version_ev.set()
+        _t.sleep(0.3)
+        assert srv.cluster_version() == "2.1.0"  # no downgrade
+
+        # Everyone upgraded to 2.2 -> cluster version rises.
+        srv._get_versions = lambda: {1: "2.2.1", 2: "2.2.0", 3: "2.2.3"}
+        srv._force_version_ev.set()
+        deadline = _t.time() + 10
+        while _t.time() < deadline and srv.cluster_version() != "2.2.0":
+            _t.sleep(0.05)
+        assert srv.cluster_version() == "2.2.0"
+
+        # An unreachable member blocks any further decision.
+        srv._get_versions = lambda: {1: "2.3.0", 2: None, 3: "2.3.0"}
+        assert srv._decide_cluster_version() is None
+        srv._force_version_ev.set()
+        _t.sleep(0.3)
+        assert srv.cluster_version() == "2.2.0"
+    finally:
+        srv._get_versions = orig

@@ -84,6 +84,15 @@ def member(tmp_path_factory):
     m = Etcd(cfg)
     m.start()
     assert m.wait_leader(10)
+    # Security endpoints are capability-gated on cluster version >= 2.1.0;
+    # negotiation is continuous (monitorVersions) and races the first
+    # request, exactly like real etcd's rolling-upgrade window — wait for
+    # it like a real client would.
+    import time as _t
+    deadline = _t.time() + 10
+    while _t.time() < deadline and m.server.cluster_version() < "2.1.0":
+        _t.sleep(0.02)
+    assert m.server.cluster_version() >= "2.1.0"
     yield m
     m.stop()
 
