@@ -117,16 +117,26 @@ class ProxyServer:
                                    parse_initial_cluster(s).values()
                                    for u in urls]
 
-        self.director = Director(self._refresh_urls)
-        rp = ReverseProxy(self.director)
-        handler = readonly(rp.handle) if cfg.is_readonly_proxy else rp.handle
-        self.http: List[HttpServer] = []
-        # The proxy's client listener honors the same TLS + CORS flags as a
-        # member's (reference startProxy wires the client TLSInfo,
-        # etcdmain/etcd.go:234-335).
+        # The proxy honors the same TLS + CORS flags as a member: the
+        # client TLSInfo secures its listener AND its outbound transport to
+        # the cluster (reference startProxy, etcdmain/etcd.go:234-335);
+        # the peer TLSInfo authenticates the /members refresh against
+        # mutual-TLS peer listeners.
         client_tls = TLSInfo(cert_file=cfg.cert_file, key_file=cfg.key_file,
                              ca_file=cfg.ca_file,
                              client_cert_auth=cfg.client_cert_auth)
+        peer_tls = TLSInfo(cert_file=cfg.peer_cert_file,
+                           key_file=cfg.peer_key_file,
+                           ca_file=cfg.peer_ca_file,
+                           client_cert_auth=cfg.peer_client_cert_auth)
+        self._out_ctx = (client_tls.client_context()
+                         if not client_tls.empty() else None)
+        self._peer_ctx = (peer_tls.client_context()
+                          if not peer_tls.empty() else None)
+        self.director = Director(self._refresh_urls)
+        rp = ReverseProxy(self.director, tls_context=self._out_ctx)
+        handler = readonly(rp.handle) if cfg.is_readonly_proxy else rp.handle
+        self.http: List[HttpServer] = []
         for url in cfg.listen_client_urls:
             from etcd_tpu.embed import _listen_addr
             host, port = _listen_addr(url)
@@ -139,7 +149,8 @@ class ProxyServer:
                              if not client_tls.empty() else None)))
 
     def _refresh_urls(self) -> List[str]:
-        client_urls, peer_urls = fetch_cluster_urls(self._peer_urls)
+        client_urls, peer_urls = fetch_cluster_urls(
+            self._peer_urls, tls_context=self._peer_ctx)
         if peer_urls:
             self._peer_urls = peer_urls
             tmp = self._clusterfile + ".bak"

@@ -46,9 +46,13 @@ def _clean_headers(src) -> Dict[str, str]:
 class ReverseProxy:
     """Install as a catch-all route: ``router.add("/", proxy.handle)``."""
 
-    def __init__(self, director: Director, dial_timeout: float = 5.0) -> None:
+    def __init__(self, director: Director, dial_timeout: float = 5.0,
+                 tls_context=None) -> None:
         self.director = director
         self.dial_timeout = dial_timeout
+        # ssl context for https:// upstream endpoints (reference startProxy
+        # wires the client TLSInfo into the outbound transport).
+        self.tls_context = tls_context
 
     def handle(self, ctx: Ctx, suffix: str) -> None:
         endpoints = self.director.endpoints()
@@ -86,8 +90,13 @@ class ReverseProxy:
                        body: bytes, headers: Dict[str, str]
                        ) -> Optional[http.client.HTTPConnection]:
         u = urlsplit(base)
-        conn = http.client.HTTPConnection(u.hostname, u.port,
-                                          timeout=self.dial_timeout)
+        if u.scheme == "https":
+            conn = http.client.HTTPSConnection(u.hostname, u.port,
+                                               timeout=self.dial_timeout,
+                                               context=self.tls_context)
+        else:
+            conn = http.client.HTTPConnection(u.hostname, u.port,
+                                              timeout=self.dial_timeout)
         try:
             conn.connect()
             # Dial succeeded — lift the deadline so long-polls can park.
@@ -162,8 +171,8 @@ def readonly(handler: Callable[[Ctx, str], None]) -> Callable[[Ctx, str], None]:
     return wrapped
 
 
-def fetch_cluster_urls(peer_urls: Iterable[str], timeout: float = 2.0
-                       ) -> Tuple[List[str], List[str]]:
+def fetch_cluster_urls(peer_urls: Iterable[str], timeout: float = 2.0,
+                       tls_context=None) -> Tuple[List[str], List[str]]:
     """GET /members from each peer until one answers; return
     (client_urls, peer_urls) of the cluster — the proxy's view-refresh
     primitive (reference cluster_util.go:54-98 GetClusterFromRemotePeers,
@@ -171,8 +180,13 @@ def fetch_cluster_urls(peer_urls: Iterable[str], timeout: float = 2.0
     for base in peer_urls:
         u = urlsplit(base)
         try:
-            conn = http.client.HTTPConnection(u.hostname, u.port,
-                                              timeout=timeout)
+            if u.scheme == "https":
+                conn = http.client.HTTPSConnection(u.hostname, u.port,
+                                                   timeout=timeout,
+                                                   context=tls_context)
+            else:
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=timeout)
             try:
                 conn.request("GET", "/members")
                 resp = conn.getresponse()

@@ -282,19 +282,26 @@ class Cluster:
 # -- remote bootstrap helpers (reference etcdserver/cluster_util.go) ----------
 
 def get_cluster_from_remote_peers(peer_urls: Sequence[str],
-                                  timeout: float = 2.0
+                                  timeout: float = 2.0, tls_context=None
                                   ) -> Tuple[int, List[Member]]:
     """GET /members from each peer URL until one answers; returns
     (cluster_id, members) — the joiner's view of the existing cluster
-    (reference GetClusterFromRemotePeers cluster_util.go:54-98)."""
+    (reference GetClusterFromRemotePeers cluster_util.go:54-98).
+    tls_context secures https:// peers (joining a mutual-TLS cluster
+    requires the same peer cert the raft transport presents)."""
     import http.client
     from urllib.parse import urlsplit
 
     for base in peer_urls:
         u = urlsplit(base)
         try:
-            conn = http.client.HTTPConnection(u.hostname, u.port,
-                                              timeout=timeout)
+            if u.scheme == "https":
+                conn = http.client.HTTPSConnection(u.hostname, u.port,
+                                                   timeout=timeout,
+                                                   context=tls_context)
+            else:
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=timeout)
             try:
                 conn.request("GET", "/members")
                 resp = conn.getresponse()

@@ -192,7 +192,9 @@ class EtcdServer:
                 f"{sorted(cfg.initial_cluster)}")
         remote_urls = [u for name, urls in cfg.initial_cluster.items()
                        if name != cfg.name for u in urls]
-        cid, existing = cl.get_cluster_from_remote_peers(remote_urls)
+        cid, existing = cl.get_cluster_from_remote_peers(
+            remote_urls,
+            tls_context=getattr(self.transport, "tls_context", None))
         cl.validate_cluster_and_assign_ids(local, existing)
         local.cluster_id = cid
         self.cluster = local

@@ -421,7 +421,10 @@ class Tester:
                 method="PUT",
                 headers={"Content-Type":
                          "application/x-www-form-urlencoded"})
-            deadline = time.time() + 30
+            # Generous: member subprocesses share CPUs with the test
+            # runner; the reference tester budgets minutes per round
+            # (etcd-tester/tester.go round deadlines).
+            deadline = time.time() + 90
             while True:
                 try:
                     with urllib.request.urlopen(req, timeout=2.0) as r:

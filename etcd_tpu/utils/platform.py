@@ -18,6 +18,25 @@ import re
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# Persistent XLA compile cache shared across processes AND driver rounds:
+# the batched kernel's TPU compile measured ~235s at G=100k — without the
+# cache a fresh bench process burns its whole budget compiling.
+CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+
+
+def enable_compile_cache() -> None:
+    """Turn on JAX's persistent compilation cache under the repo root.
+    Safe to call multiple times / before or after backend init."""
+    import jax
+
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax or read-only fs: cache is an optimization only
+
 
 def set_host_device_count(n: int) -> None:
     """Set (or raise to n) the virtual CPU device count in XLA_FLAGS.

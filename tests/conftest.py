@@ -10,9 +10,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
-from etcd_tpu.utils.platform import force_cpu  # noqa: E402
+from etcd_tpu.utils.platform import enable_compile_cache, force_cpu  # noqa: E402
 
 force_cpu(8)
+enable_compile_cache()
 
 import jax  # noqa: E402
 
