@@ -201,6 +201,34 @@ def child_main() -> int:
                         int((sc_deadline - time.time() - 1.0)
                             / max(est, 1e-4))))
 
+        # --- Throughput phase: PIPELINED rounds (no per-round host sync —
+        # dispatch streams ahead, exactly how a serving engine overlaps
+        # readback with the next round; per-round sync would bill the
+        # host<->device round-trip latency to every round). Churn
+        # partitions are injected here too (the sync at each churn
+        # boundary is the scenario's own cost).
+        n_t = max(n, 20)
+        _, _, cm0_t = extract(st, slots)
+        jax.block_until_ready(cm0_t)
+        t0 = time.time()
+        for r in range(n_t):
+            if scenario == "churn":
+                ph = r % churn_period
+                if ph == 0:
+                    drop, _ = churn_mask(current_slots(st))
+                elif ph == churn_len:
+                    drop = None
+            st, inbox = one_round(r, st, inbox, slots, drop)
+        jax.block_until_ready(st.commit)
+        t_elapsed = time.time() - t0
+        _, _, cm1_t = extract(st, slots)
+        commits_t = int((np.asarray(cm1_t) - np.asarray(cm0_t)).sum())
+        cps = commits_t / t_elapsed
+        pipelined_round_ms = 1000.0 * t_elapsed / n_t
+
+        # --- Latency phase: per-round synced history for the
+        # propose->commit estimator (bounded; sync costs dominate it).
+        n = min(n, 60)
         slots_np = current_slots(st)
         slots = jnp.asarray(slots_np)
         stable = np.ones(G, bool)   # groups whose leader never churned
@@ -228,12 +256,6 @@ def child_main() -> int:
         li_h = np.asarray(jnp.stack(li_hist))   # (n, G)
         ci_h = np.asarray(jnp.stack(ci_hist))
         li0, ci0 = np.asarray(li0), np.asarray(ci0)
-        # Commit progress counted as max over peers per group — correct
-        # across leader changes (a deposed leader's fixed-slot view
-        # freezes); the fixed-slot arrays serve the latency estimator on
-        # stable groups only.
-        commits = int((np.asarray(cm) - np.asarray(cm0)).sum())
-        cps = commits / elapsed
         round_ms = 1000.0 * elapsed / n
 
         # Measured propose->commit latency over sampled STABLE groups:
@@ -265,14 +287,19 @@ def child_main() -> int:
             extra["groups_with_leader_at_end"] = int(
                 (np.asarray(st.state) == LEADER).any(axis=1).sum())
 
-        log(f"[{scenario}] G={G} P={P}: {commits} commits in {elapsed:.2f}s "
-            f"/ {n} rounds ({round_ms:.2f} ms/round) -> {cps:,.0f} "
-            f"commits/s; latency p50 {p50} p99 {p99} ms over {nlat} "
-            f"proposals (stable groups: {int(stable.sum())})")
+        log(f"[{scenario}] G={G} P={P}: {commits_t} commits in "
+            f"{t_elapsed:.2f}s / {n_t} pipelined rounds "
+            f"({pipelined_round_ms:.2f} ms/round) -> {cps:,.0f} commits/s; "
+            f"synced-loop latency p50 {p50} p99 {p99} ms over {nlat} "
+            f"proposals ({n} rounds at {round_ms:.2f} ms, stable groups: "
+            f"{int(stable.sum())})")
         res = {"commits_per_sec": round(cps, 1),
+               "round_ms_pipelined": round(pipelined_round_ms, 3),
+               "rounds_pipelined": n_t,
                "p50_commit_latency_ms": p50,
                "p99_commit_latency_ms": p99,
-               "round_ms": round(round_ms, 3), "rounds": n, **extra}
+               "round_ms_synced": round(round_ms, 3),
+               "rounds_synced": n, **extra}
         return res, st, inbox
 
     sel = scenario
@@ -297,8 +324,8 @@ def child_main() -> int:
                                  / BASELINE_WRITES_PER_SEC, 2),
             "p50_commit_latency_ms": primary["p50_commit_latency_ms"],
             "p99_commit_latency_ms": primary["p99_commit_latency_ms"],
-            "round_ms": primary["round_ms"],
-            "rounds": primary["rounds"],
+            "round_ms": primary["round_ms_pipelined"],
+            "rounds": primary["rounds_pipelined"],
             "platform": devs[0].platform,
             "scenario": order[0],
             "scenarios": {k: v for k, v in results.items()

@@ -126,8 +126,10 @@ class TenantAPI:
             "groups": eng.cfg.groups,
             "peers": eng.cfg.peers,
             "round": eng.round_no,
+            "round_ms_ewma": round(eng.round_ms_ewma, 3),
             "groups_with_leader": leaders,
             "applied_total": int(eng.applied.sum()),
+            "pending_payloads": len(eng.payloads),
         })
 
     def handle_health(self, ctx: Ctx, suffix: str) -> None:

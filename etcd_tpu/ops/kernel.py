@@ -48,7 +48,8 @@ from etcd_tpu.ops.state import (CANDIDATE, FOLLOWER, F_COMMIT, F_HINT,
                                 M_APP, M_APP_RESP, M_HB, M_HB_RESP, M_NONE,
                                 M_VOTE, M_VOTE_RESP, N_FIXED_FIELDS,
                                 PR_PROBE, PR_REPLICATE, active_mask,
-                                in_window, quorum, term_at, xorshift32)
+                                in_window, quorum, ring_lookup, term_at,
+                                xorshift32)
 
 
 def _where(m, a, b):
@@ -91,15 +92,11 @@ def _append_noop_and_lead(st: GroupState, cfg: KernelConfig,
     term (reference raft.go:406-427)."""
     G, P = st.term.shape
     new_last = st.last_index + 1
-    slot = jnp.mod(new_last, cfg.window)
-    log_term = _where(
-        win[..., None],
-        st.log_term.at[
-            jnp.arange(G)[:, None, None],
-            jnp.arange(P)[None, :, None],
-            slot[..., None],
-        ].set(st.term[..., None]),
-        st.log_term)
+    # Slot-wise select instead of a computed scatter (TPU scatters
+    # serialize): exactly one ring slot per instance takes the no-op term.
+    w_idx = jnp.arange(cfg.window, dtype=jnp.int32)[None, None, :]
+    hit = win[..., None] & (w_idx == jnp.mod(new_last, cfg.window)[..., None])
+    log_term = _where(hit, st.term[..., None], st.log_term)
     st = st._replace(
         state=_where(win, LEADER, st.state),
         lead=_where(win, jnp.arange(1, P + 1, dtype=jnp.int32)[None, :],
@@ -237,7 +234,10 @@ def _step_msgs_from(st: GroupState, cfg: KernelConfig, q: int,
     # -- MsgVoteResp (reference stepCandidate raft.go:603-612) --------------
     vr = live & is_c & (mtype == M_VOTE_RESP)
     first = st.votes[:, :, q] == 0
-    vote_val = _where(mreject == 0, 1, 2)
+    # int32 literals: under x64 test configs plain ints promote to int64
+    # and the votes scatter would mix dtypes (FutureWarning today, error
+    # in future jax).
+    vote_val = _where(mreject == 0, jnp.int32(1), jnp.int32(2))
     votes = st.votes.at[:, :, q].set(
         _where(vr & first, vote_val, st.votes[:, :, q]))
     st = st._replace(votes=votes)
@@ -288,8 +288,8 @@ def _step_msgs_from(st: GroupState, cfg: KernelConfig, q: int,
     st = st._replace(need_host=st.need_host | (any_conf & (ci <= st.commit)))
 
     do_append = any_conf
-    write_j = do_append[..., None] & valid_j & (idx_j >= ci[..., None])
-    st = _write_terms(st, cfg, idx_j, ent_terms, write_j)
+    st = _write_terms(st, cfg, anchor=mindex, terms=ent_terms, lo=ci,
+                      count=mnent, mask=do_append)
     lastnewi = mindex + mnent
     old_last = st.last_index
     st = st._replace(
@@ -408,23 +408,37 @@ def _terms_at_many(st: GroupState, cfg: KernelConfig,
     """term_at for an extra trailing axis of indices: idx (G, P, E) ->
     terms (G, P, E); 0 outside the window / beyond last."""
     slot = jnp.mod(idx, cfg.window)
-    t = jnp.take_along_axis(st.log_term, slot, axis=2)
+    t = ring_lookup(st.log_term, slot)
     last = st.last_index[..., None]
     valid = (idx > last - cfg.window) & (idx <= last) & (idx >= 1)
     return jnp.where(valid, t, 0)
 
 
-def _write_terms(st: GroupState, cfg: KernelConfig, idx: jax.Array,
-                 terms: jax.Array, mask: jax.Array) -> GroupState:
-    """Scatter entry terms into the log ring at absolute indices idx (G,P,E)
-    where mask holds."""
-    G, P, E = idx.shape
-    slot = jnp.mod(idx, cfg.window)
-    gi = jnp.broadcast_to(jnp.arange(G)[:, None, None], (G, P, E))
-    pi = jnp.broadcast_to(jnp.arange(P)[None, :, None], (G, P, E))
-    cur = jnp.take_along_axis(st.log_term, slot, axis=2)
-    new = jnp.where(mask, terms, cur)
-    return st._replace(log_term=st.log_term.at[gi, pi, slot].set(new))
+def _write_terms(st: GroupState, cfg: KernelConfig, anchor: jax.Array,
+                 terms: jax.Array, lo: jax.Array, count: jax.Array,
+                 mask: jax.Array) -> GroupState:
+    """Write entry terms for the contiguous index range
+    (max(lo, anchor+1) .. anchor+count] into the log ring, where entry
+    anchor+1+j takes terms[..., j].
+
+    Formulated ring-slot-wise (one gather + elementwise select over the W
+    axis) instead of as a scatter: TPU scatters with computed indices
+    serialize, and this runs on every message-phase pass. Each ring slot w
+    maps to at most ONE index in the range (count <= E < W), namely
+    j_w = (w - (anchor+1)) mod W.
+
+    anchor/lo/count: (G, P); terms: (G, P, E); mask: (G, P).
+    """
+    W = cfg.window
+    E = terms.shape[-1]
+    w_idx = jnp.arange(W, dtype=jnp.int32)[None, None, :]
+    j_w = jnp.mod(w_idx - (anchor[..., None] + 1), W)
+    idx_w = anchor[..., None] + 1 + j_w
+    write = (mask[..., None] & (j_w < count[..., None])
+             & (idx_w >= lo[..., None]))
+    val = ring_lookup(terms, jnp.minimum(j_w, E - 1))
+    return st._replace(
+        log_term=jnp.where(write, val, st.log_term))
 
 
 # ---------------------------------------------------------------------------
@@ -452,10 +466,11 @@ def _apply_proposals(st: GroupState, cfg: KernelConfig, prop_count: jax.Array,
     cnt = jnp.minimum(jnp.minimum(prop_count[:, None], cfg.max_ents), room)
     cnt = cnt * is_ldr.astype(jnp.int32)
     E = cfg.max_ents
-    idx_j = st.last_index[..., None] + 1 + jnp.arange(E, dtype=jnp.int32)[None, None]
-    write_j = jnp.arange(E)[None, None] < cnt[..., None]
-    terms = jnp.broadcast_to(st.term[..., None], idx_j.shape)
-    st = _write_terms(st, cfg, idx_j, terms, write_j)
+    terms = jnp.broadcast_to(st.term[..., None],
+                             (*st.term.shape, E))
+    st = _write_terms(st, cfg, anchor=st.last_index, terms=terms,
+                      lo=st.last_index + 1, count=cnt,
+                      mask=cnt > 0)
     st = st._replace(last_index=st.last_index + cnt)
     return _set_self_progress(st)
 
@@ -473,9 +488,8 @@ def _quorum_commit(st: GroupState, cfg: KernelConfig,
     mrow = _where(eye, st.last_index[..., None], st.match)
     mrow = _where(target_active, mrow, -1)
     topk, _ = jax.lax.top_k(mrow, P)  # sorted descending
-    qidx = (quorum(st) - 1)[:, None, None]
-    mci = jnp.take_along_axis(topk, jnp.broadcast_to(qidx, (G, P, 1)),
-                              axis=2)[..., 0]
+    qidx = jnp.broadcast_to((quorum(st) - 1)[:, None, None], (G, P, 1))
+    mci = ring_lookup(topk, qidx)[..., 0]
     # Only entries from the leader's own term commit by counting
     # (raftLog.maybeCommit; Raft paper §5.4.2).
     mci_term = term_at(st, cfg, jnp.maximum(mci, 0))
@@ -522,11 +536,12 @@ def _assemble_sends(st: GroupState, cfg: KernelConfig, resp: jax.Array,
     n = jnp.minimum(last - st.next + 1, E)
     n = _where(send_app, n, 0)
 
-    # Entry terms for slots next .. next+n-1, gathered from the log ring.
+    # Entry terms for slots next .. next+n-1, from the SENDER's ring; the
+    # one-hot select-sum broadcasts the (G,P,1,W) ring across targets
+    # without materializing a (G,P,P,W) copy.
     idx_e = st.next[..., None] + jnp.arange(E, dtype=jnp.int32)[None, None, None]
     slot_e = jnp.mod(idx_e, cfg.window)
-    ring = jnp.broadcast_to(st.log_term[:, :, None, :], (G, P, P, cfg.window))
-    terms_e = jnp.take_along_axis(ring, slot_e, axis=3)
+    terms_e = ring_lookup(st.log_term[:, :, None, :], slot_e)
     valid_e = jnp.arange(E)[None, None, None] < n[..., None]
     terms_e = jnp.where(valid_e, terms_e, 0)
 

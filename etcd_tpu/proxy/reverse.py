@@ -89,14 +89,8 @@ class ReverseProxy:
     def _dial_and_send(self, base: str, method: str, target: str,
                        body: bytes, headers: Dict[str, str]
                        ) -> Optional[http.client.HTTPConnection]:
-        u = urlsplit(base)
-        if u.scheme == "https":
-            conn = http.client.HTTPSConnection(u.hostname, u.port,
-                                               timeout=self.dial_timeout,
-                                               context=self.tls_context)
-        else:
-            conn = http.client.HTTPConnection(u.hostname, u.port,
-                                              timeout=self.dial_timeout)
+        from etcd_tpu.utils.tlsutil import open_conn
+        conn = open_conn(base, self.dial_timeout, self.tls_context)
         try:
             conn.connect()
             # Dial succeeded — lift the deadline so long-polls can park.
@@ -177,16 +171,10 @@ def fetch_cluster_urls(peer_urls: Iterable[str], timeout: float = 2.0,
     (client_urls, peer_urls) of the cluster — the proxy's view-refresh
     primitive (reference cluster_util.go:54-98 GetClusterFromRemotePeers,
     used by etcdmain/etcd.go:288-323 startProxy's urls func)."""
+    from etcd_tpu.utils.tlsutil import open_conn
     for base in peer_urls:
-        u = urlsplit(base)
         try:
-            if u.scheme == "https":
-                conn = http.client.HTTPSConnection(u.hostname, u.port,
-                                                   timeout=timeout,
-                                                   context=tls_context)
-            else:
-                conn = http.client.HTTPConnection(u.hostname, u.port,
-                                                  timeout=timeout)
+            conn = open_conn(base, timeout, tls_context)
             try:
                 conn.request("GET", "/members")
                 resp = conn.getresponse()

@@ -289,19 +289,11 @@ def get_cluster_from_remote_peers(peer_urls: Sequence[str],
     (reference GetClusterFromRemotePeers cluster_util.go:54-98).
     tls_context secures https:// peers (joining a mutual-TLS cluster
     requires the same peer cert the raft transport presents)."""
-    import http.client
-    from urllib.parse import urlsplit
+    from etcd_tpu.utils.tlsutil import open_conn
 
     for base in peer_urls:
-        u = urlsplit(base)
         try:
-            if u.scheme == "https":
-                conn = http.client.HTTPSConnection(u.hostname, u.port,
-                                                   timeout=timeout,
-                                                   context=tls_context)
-            else:
-                conn = http.client.HTTPConnection(u.hostname, u.port,
-                                                  timeout=timeout)
+            conn = open_conn(base, timeout, tls_context)
             try:
                 conn.request("GET", "/members")
                 resp = conn.getresponse()

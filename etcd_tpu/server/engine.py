@@ -115,6 +115,7 @@ class MultiEngine:
         self._stop_ev = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.round_no = 0
+        self.round_ms_ewma = 0.0   # smoothed wall time per round
 
         # Host mirrors of the last read-back device state.
         self.h_term = np.zeros((G, P), np.int32)
@@ -387,6 +388,27 @@ class MultiEngine:
             "active_slots": [int(s) for s in np.nonzero(self.h_mask[g])[0]],
         }
 
+    def profile(self, rounds: int = 20, out_dir: Optional[str] = None) -> str:
+        """Capture an XLA/device profile of `rounds` engine rounds (the
+        per-batch-step profiler hook SURVEY §5 calls for). Writes a
+        TensorBoard-loadable trace under <data_dir>/profiles and returns
+        the path. Drive rounds manually if the engine thread isn't
+        running."""
+        import os
+        out = out_dir or os.path.join(self.cfg.data_dir, "profiles")
+        os.makedirs(out, exist_ok=True)
+        running = self._thread is not None and self._thread.is_alive()
+        with self._jax.profiler.trace(out):
+            if running:
+                target = self.round_no + rounds
+                while (self.round_no < target
+                       and not self._stop_ev.is_set()):
+                    time.sleep(0.001)
+            else:
+                for _ in range(rounds):
+                    self.run_round()
+        return out
+
     # ------------------------------------------------------------------
     # the round
     # ------------------------------------------------------------------
@@ -400,6 +422,7 @@ class MultiEngine:
     def run_round(self) -> None:
         """One engine round. Callable directly (tests drive the engine
         synchronously); the background thread just loops it."""
+        t_round = time.perf_counter()
         jnp, kernel = self._jnp, self._kernel
         G, P, W, E = (self.cfg.groups, self.cfg.peers, self.cfg.window,
                       self.cfg.max_ents)
@@ -508,6 +531,8 @@ class MultiEngine:
             self._service_need_host(need_host)
 
         self.round_no += 1
+        ms = (time.perf_counter() - t_round) * 1000.0
+        self.round_ms_ewma += 0.05 * (ms - self.round_ms_ewma)
         if self.round_no % self.cfg.checkpoint_rounds == 0:
             self._checkpoint()
             self._gc_payloads()

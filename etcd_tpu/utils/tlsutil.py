@@ -69,3 +69,19 @@ def client_context_or_none(info: Optional["TLSInfo"]) -> Optional[ssl.SSLContext
     if info is None or info.empty():
         return None
     return info.client_context()
+
+
+def open_conn(url: str, timeout: float, tls_context=None):
+    """http.client connection for `url`, TLS-aware: HTTPSConnection with
+    the given context for https://, plain HTTPConnection otherwise. The
+    single construction point for every outbound TLS-capable dialer
+    (peer /members fetches, proxy upstream relay)."""
+    import http.client
+    from urllib.parse import urlsplit
+
+    u = urlsplit(url)
+    if u.scheme == "https":
+        return http.client.HTTPSConnection(u.hostname, u.port,
+                                           timeout=timeout,
+                                           context=tls_context)
+    return http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)

@@ -317,3 +317,21 @@ def test_engine_http_surface(tmp_path):
     finally:
         front.stop()
         eng.stop()
+
+
+def test_engine_profile_hook(tmp_path):
+    """SURVEY §5 A1: the per-batch-step XLA profiler hook produces a
+    TensorBoard-loadable trace directory."""
+    import os
+    eng = MultiEngine(make_cfg(tmp_path / "e9"))
+    try:
+        run_until(eng, lambda: eng.leader_slot(0) >= 0, msg="leader")
+        out = eng.profile(rounds=3)
+        assert os.path.isdir(out)
+        found = []
+        for root, _, files in os.walk(out):
+            found.extend(files)
+        assert found, "profiler produced no trace files"
+        assert eng.round_ms_ewma > 0
+    finally:
+        eng.stop()
