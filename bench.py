@@ -206,8 +206,10 @@ def child_main() -> int:
         # readback with the next round; per-round sync would bill the
         # host<->device round-trip latency to every round). Churn
         # partitions are injected here too (the sync at each churn
-        # boundary is the scenario's own cost).
-        n_t = max(n, 20)
+        # boundary is the scenario's own cost). Takes ~60% of the scenario
+        # budget; the synced latency phase gets the rest.
+        n_t = max(min(n, int(0.6 * (sc_deadline - time.time())
+                             / max(est, 1e-4))), 20)
         _, _, cm0_t = extract(st, slots)
         jax.block_until_ready(cm0_t)
         t0 = time.time()
@@ -237,6 +239,7 @@ def child_main() -> int:
         li_hist, ci_hist = [], []
         t_hist = np.zeros(n + 1)
         t_hist[0] = time.time()
+        done = 0
         for r in range(n):
             if scenario == "churn":
                 ph = r % churn_period
@@ -251,6 +254,14 @@ def child_main() -> int:
             ci_hist.append(ci)
             jax.block_until_ready(cm)
             t_hist[r + 1] = time.time()
+            done = r + 1
+            # Each synced round pays the full host<->device round trip,
+            # which est (mostly unsynced) did not price in — stop at the
+            # deadline instead of overrunning the whole scenario matrix.
+            if done >= 10 and time.time() > sc_deadline:
+                break
+        n = done
+        t_hist = t_hist[:n + 1]
         elapsed = t_hist[n] - t_hist[0]
 
         li_h = np.asarray(jnp.stack(li_hist))   # (n, G)

@@ -1,0 +1,67 @@
+"""Native (C) hot paths with pure-Python fallbacks.
+
+`./build` compiles walcodec.c into this package; everything here works
+without it (the Python fallbacks are the reference implementations and
+tests assert byte-identical behavior — tests/test_native.py).
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import List, Tuple
+
+_HDR = struct.Struct("<IIQ")
+
+try:
+    from etcd_tpu.native.walcodec import (encode_records as _c_encode,
+                                          scan_records as _c_scan)
+    HAVE_NATIVE = True
+except ImportError:
+    _c_encode = _c_scan = None
+    HAVE_NATIVE = False
+
+
+def _py_encode_records(records, crc: int) -> Tuple[bytes, int]:
+    out = []
+    for rtype, payload in records:
+        crc = zlib.crc32(payload, crc) & 0xFFFFFFFF
+        out.append(_HDR.pack(rtype, crc, len(payload)))
+        out.append(payload)
+    return b"".join(out), crc
+
+
+def _py_scan_records(data: bytes, crc: int
+                     ) -> Tuple[List[Tuple[int, bytes]], int, int]:
+    out = []
+    off = 0
+    n = len(data)
+    while off + _HDR.size <= n:
+        rtype, rcrc, ln = _HDR.unpack_from(data, off)
+        if off + _HDR.size + ln > n:
+            break  # torn tail
+        payload = data[off + _HDR.size: off + _HDR.size + ln]
+        c = zlib.crc32(payload, crc) & 0xFFFFFFFF
+        if c != rcrc:
+            break  # bit flip: stop at the last good record
+        crc = c
+        out.append((rtype, payload))
+        off += _HDR.size + ln
+    return out, crc, off
+
+
+def encode_records(records, crc: int) -> Tuple[bytes, int]:
+    """Frame + chain-CRC a batch of (type, payload) records; returns
+    (buffer, new_crc). One call per fsync batch."""
+    if _c_encode is not None:
+        return _c_encode(list(records), crc)
+    return _py_encode_records(records, crc)
+
+
+def scan_records(data: bytes, crc: int
+                 ) -> Tuple[List[Tuple[int, bytes]], int, int]:
+    """Decode + CRC-verify records from `data` starting at chain value
+    `crc`; returns (records, new_crc, bytes_consumed). Stops cleanly at a
+    torn tail or a checksum mismatch."""
+    if _c_scan is not None:
+        return _c_scan(data, crc)
+    return _py_scan_records(data, crc)

@@ -92,18 +92,16 @@ def _append_noop_and_lead(st: GroupState, cfg: KernelConfig,
     term (reference raft.go:406-427)."""
     G, P = st.term.shape
     new_last = st.last_index + 1
-    # Slot-wise select instead of a computed scatter (TPU scatters
-    # serialize): exactly one ring slot per instance takes the no-op term.
-    w_idx = jnp.arange(cfg.window, dtype=jnp.int32)[None, None, :]
-    hit = win[..., None] & (w_idx == jnp.mod(new_last, cfg.window)[..., None])
-    log_term = _where(hit, st.term[..., None], st.log_term)
+    # The no-op entry of the new term, via the shared ring-write primitive.
+    st = _write_terms(st, cfg, anchor=st.last_index,
+                      terms=st.term[..., None], lo=new_last,
+                      count=win.astype(jnp.int32), mask=win)
     st = st._replace(
         state=_where(win, LEADER, st.state),
         lead=_where(win, jnp.arange(1, P + 1, dtype=jnp.int32)[None, :],
                     st.lead),
         elapsed=_where(win, 0, st.elapsed),
         last_index=_where(win, new_last, st.last_index),
-        log_term=log_term,
         # Progress reset: probe from the PRE-no-op last+1 (= new_last), as
         # the reference's reset() runs before appendEntry — so the no-op
         # itself replicates to quiescent followers.

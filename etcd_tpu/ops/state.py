@@ -198,7 +198,8 @@ def ring_lookup(ring: jax.Array, slot: jax.Array) -> jax.Array:
     W = ring.shape[-1]
     iota = jnp.arange(W, dtype=slot.dtype)
     onehot = (slot[..., None] == iota).astype(ring.dtype)
-    return jnp.sum(ring[..., None, :] * onehot, axis=-1)
+    # dtype pinned: under x64 configs jnp.sum would promote int32 -> int64.
+    return jnp.sum(ring[..., None, :] * onehot, axis=-1, dtype=ring.dtype)
 
 
 def term_at(st: GroupState, cfg: KernelConfig, index: jax.Array) -> jax.Array:

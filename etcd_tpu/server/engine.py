@@ -532,7 +532,10 @@ class MultiEngine:
 
         self.round_no += 1
         ms = (time.perf_counter() - t_round) * 1000.0
-        self.round_ms_ewma += 0.05 * (ms - self.round_ms_ewma)
+        if self.round_ms_ewma == 0.0:
+            self.round_ms_ewma = ms      # seed with the first sample
+        else:
+            self.round_ms_ewma += 0.05 * (ms - self.round_ms_ewma)
         if self.round_no % self.cfg.checkpoint_rounds == 0:
             self._checkpoint()
             self._gc_payloads()

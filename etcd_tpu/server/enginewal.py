@@ -186,9 +186,9 @@ class EngineWAL:
         self._write(REC_CRC, struct.pack("<I", self._crc))
 
     def _write(self, rtype: int, payload: bytes) -> None:
-        self._crc = zlib.crc32(payload, self._crc) & 0xFFFFFFFF
-        self._f.write(_HDR.pack(rtype, self._crc, len(payload)))
-        self._f.write(payload)
+        from etcd_tpu import native
+        buf, self._crc = native.encode_records([(rtype, payload)], self._crc)
+        self._f.write(buf)
 
     def append(self, rec: RoundRecord) -> None:
         """Append + (optionally) fsync one round record. MUST complete before
@@ -222,38 +222,34 @@ class EngineWAL:
         """Yield whole, checksummed round records with round_no > after_round.
         Stops cleanly at a torn tail. Also positions the writer: appends go
         to a FRESH segment after the last good record."""
+        from etcd_tpu import native
         max_seq = -1
         for name in self._segments():
             seq, _ = _parse_seg(name)
             max_seq = max(max_seq, seq)
             path = os.path.join(self.dir, name)
-            crc = None
             with open(path, "rb") as f:
                 data = f.read()
-            off = 0
-            while off + _HDR.size <= len(data):
-                rtype, rcrc, ln = _HDR.unpack_from(data, off)
-                if off + _HDR.size + ln > len(data):
-                    break  # torn tail
-                payload = data[off + _HDR.size: off + _HDR.size + ln]
-                if rtype == REC_CRC:
-                    (seed,) = struct.unpack("<I", payload)
-                    crc = zlib.crc32(payload, seed) & 0xFFFFFFFF
-                    # the CRC record chains like any other record
-                    if crc != rcrc:
-                        break
-                else:
-                    if crc is None:
-                        break  # segment without CRC head: corrupt
-                    crc = zlib.crc32(payload, crc) & 0xFFFFFFFF
-                    if crc != rcrc:
-                        break  # bit flip
-                    if rtype == REC_ROUND:
-                        rec = RoundRecord.decode(payload)
-                        if rec.round_no > after_round:
-                            yield rec
-                off += _HDR.size + ln
-            self._crc = crc if crc is not None else self._crc
+            # Head CRC record seeds the chain (its payload IS the seed, and
+            # it chains over itself like every record).
+            if len(data) < _HDR.size:
+                continue
+            rtype, rcrc, ln = _HDR.unpack_from(data, 0)
+            if (rtype != REC_CRC or _HDR.size + ln > len(data)):
+                continue  # segment without a valid CRC head: corrupt
+            payload = data[_HDR.size:_HDR.size + ln]
+            (seed,) = struct.unpack("<I", payload)
+            crc = zlib.crc32(payload, seed) & 0xFFFFFFFF
+            if crc != rcrc:
+                continue
+            # Verified batch scan of the remainder (C when built).
+            recs, crc, _ = native.scan_records(data[_HDR.size + ln:], crc)
+            for rt, pl in recs:
+                if rt == REC_ROUND:
+                    rec = RoundRecord.decode(pl)
+                    if rec.round_no > after_round:
+                        yield rec
+            self._crc = crc
         self._seq = max_seq
 
     # -- checkpoints --------------------------------------------------------
