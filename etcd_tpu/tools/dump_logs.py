@@ -90,11 +90,58 @@ def dump(data_dir: str, out=sys.stdout) -> int:
     return 0
 
 
+def dump_engine(data_dir: str, out=sys.stdout) -> int:
+    """Inspect a MultiEngine data dir: newest checkpoint summary + every
+    WAL round record (HardState/ring delta counts, admitted entries with
+    decoded payloads, membership flips)."""
+    from etcd_tpu.server.engine import P_CONF, P_REQ
+    from etcd_tpu.server.enginewal import CONF_ADD, EngineWAL
+
+    w = EngineWAL(data_dir, fsync=False)
+    ckpt_round, ckpt = w.load_checkpoint()
+    if ckpt is not None:
+        print(f"Checkpoint: round={ckpt_round} stores="
+              f"{len(ckpt.get('stores', {}))} "
+              f"pending_payloads={len(ckpt.get('payloads', []))}", file=out)
+    else:
+        print("Checkpoint: none", file=out)
+    print("round\ths\tlast\tring\tentries/confs", file=out)
+    n = 0
+    for rec in w.replay(after_round=ckpt_round):
+        n += 1
+        detail = []
+        for g, i, t, payload in rec.entries:
+            kind = "?"
+            body = ""
+            if payload[:1] == bytes([P_REQ]):
+                try:
+                    r = Request.decode(payload[1:])
+                    kind, body = "req", f"{r.method} {r.path}"
+                except ValueError:
+                    kind = "req<bad>"
+            elif payload[:1] == bytes([P_CONF]):
+                kind, body = "conf", payload[1:].decode(errors="replace")
+            detail.append(f"g{g}@{i}.t{t} {kind} {body}".rstrip())
+        for g, slot, op in rec.confs:
+            detail.append(f"g{g} slot{slot} "
+                          f"{'ADD' if op == CONF_ADD else 'REMOVE'}")
+        print(f"{rec.round_no}\t{len(rec.hs_g)}\t{len(rec.last_g)}\t"
+              f"{len(rec.ring_g)}\t{'; '.join(detail)}", file=out)
+    print(f"{n} round records after checkpoint", file=out)
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--engine":
+        if len(argv) != 2:
+            print("usage: python -m etcd_tpu.tools.dump_logs --engine <dir>",
+                  file=sys.stderr)
+            return 2
+        return dump_engine(argv[1])
     if len(argv) != 1:
-        print("usage: python -m etcd_tpu.tools.dump_logs <data-dir>",
-              file=sys.stderr)
+        print("usage: python -m etcd_tpu.tools.dump_logs [--engine] "
+              "<data-dir>", file=sys.stderr)
         return 2
     return dump(argv[0])
 

@@ -81,3 +81,42 @@ def test_dump_logs(tmp_path):
 
     # bad dir answers nonzero
     assert dump_logs.dump(str(tmp_path / "nope")) == 1
+
+
+def test_dump_engine_wal(tmp_path, capsys):
+    import io
+
+    from etcd_tpu.server.engine import EngineConfig, MultiEngine
+    from etcd_tpu.server.request import Request
+    from etcd_tpu.tools.dump_logs import dump_engine
+
+    eng = MultiEngine(EngineConfig(groups=2, peers=3, window=16, max_ents=4,
+                                   data_dir=str(tmp_path / "e"),
+                                   fsync=False, request_timeout=30.0))
+    try:
+        for _ in range(200):
+            if all(eng.leader_slot(g) >= 0 for g in range(2)):
+                break
+            eng.run_round()
+        import threading
+        out = {}
+
+        def put():
+            out["r"] = eng.do(0, Request(method="PUT", path="/dumped",
+                                         val="v"))
+        t = threading.Thread(target=put, daemon=True)
+        t.start()
+        for _ in range(300):
+            if not t.is_alive():
+                break
+            eng.run_round()
+            t.join(timeout=0.001)
+        assert "r" in out
+    finally:
+        eng.stop()
+
+    buf = io.StringIO()
+    assert dump_engine(str(tmp_path / "e"), out=buf) == 0
+    text = buf.getvalue()
+    assert "round" in text
+    assert "PUT /dumped" in text
