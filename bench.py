@@ -16,12 +16,14 @@ Latency is MEASURED, not estimated: per-round history of the leader's
 last_index (admission time) and commit (commit time) gives per-proposal
 propose->commit latency; p50/p99 are computed over sampled groups.
 
-Robustness contract with the driver: this process ALWAYS prints exactly one
-JSON line on stdout and exits 0, within BENCH_BUDGET_S wall seconds. The
-actual measurement runs in a child process; if the child hangs (e.g. the
-ambient axon TPU tunnel blocks backend init — round 1's failure mode) the
-parent kills it, retries on forced CPU, and as a last resort emits an error
-JSON line itself.
+Robustness contract with the driver: result lines are CUMULATIVE and
+STREAMED — after every completed scenario a full JSON line (containing all
+scenarios measured so far) reaches stdout immediately, so consumers should
+take the LAST matching line; a kill at any moment after the first scenario
+still leaves a valid result. The measurement runs in a child process with a
+75s backend-init watchdog (the ambient axon TPU tunnel can hang in init —
+round 1's failure mode); the parent kills a stuck child, retries on forced
+CPU, and as a last resort emits an error JSON line.
 
 Scenario matrix (BASELINE.json configs 3-5):
   uniform — every group's leader admits max_ents/round (configs 1-2 shape)
@@ -35,7 +37,7 @@ The primary metric is the uniform run; the other scenarios run in the
 remaining budget and report under "scenarios".
 
 Env knobs: BENCH_GROUPS, BENCH_PEERS (5), BENCH_ROUNDS, BENCH_WARM_ROUNDS,
-BENCH_BUDGET_S (200), BENCH_SCENARIO (all|uniform|zipf|lag|churn),
+BENCH_BUDGET_S (480), BENCH_SCENARIO (all|uniform|zipf|lag|churn),
 BENCH_PLATFORM.
 """
 from __future__ import annotations
@@ -58,10 +60,27 @@ def log(*a):
 # ---------------------------------------------------------------------------
 
 def child_main() -> int:
-    budget = float(os.environ.get("BENCH_BUDGET_S", 200.0))
+    budget = float(os.environ.get("BENCH_BUDGET_S", 480.0))
     deadline = time.time() + budget * 0.9
     platform = os.environ.get("BENCH_PLATFORM", "auto")
     scenario = os.environ.get("BENCH_SCENARIO", "all")
+
+    import threading
+
+    # The tunneled TPU backend can hang in init (not just error) — and the
+    # hang can happen inside force_cpu()'s own jax.devices() too. Guard the
+    # WHOLE init so a stalled attempt dies fast and the parent's fallback
+    # gets the remaining budget.
+    backend_up = threading.Event()
+
+    def _bail():
+        if not backend_up.is_set():
+            log("backend init stalled >75s; aborting this attempt")
+            os._exit(7)
+
+    _t = threading.Timer(75.0, _bail)
+    _t.daemon = True
+    _t.start()
 
     if platform == "cpu":
         from etcd_tpu.utils.platform import force_cpu
@@ -79,6 +98,8 @@ def child_main() -> int:
         log(f"primary backend unavailable ({e}); falling back to CPU")
         jax.config.update("jax_platforms", "cpu")
         devs = jax.devices()
+    backend_up.set()
+    _t.cancel()
     on_tpu = devs[0].platform == "tpu"
     log(f"devices: {devs} (tpu={on_tpu})")
 
@@ -361,38 +382,61 @@ def child_main() -> int:
 # ---------------------------------------------------------------------------
 
 def _run_child(extra_env: dict, timeout_s: float):
+    """Run one measurement child, STREAMING its cumulative JSON lines to our
+    stdout the moment they appear: if an external timeout kills this whole
+    process mid-run, every scenario measured so far has already been
+    printed (consumers take the last line). Returns the last line seen."""
     env = dict(os.environ)
     env.update(extra_env)
     env["BENCH_CHILD"] = "1"
-    try:
-        p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, stdout=subprocess.PIPE, stderr=None,
-            timeout=timeout_s)
-        stdout = p.stdout
-        rc = p.returncode
-    except subprocess.TimeoutExpired as e:
-        log(f"bench child timed out after {timeout_s:.0f}s")
-        # The child emits a cumulative result line after EACH scenario —
-        # whatever it measured before the kill is in the partial output.
-        stdout = e.output or b""
-        rc = -9
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=None)
     best = None
-    for line in stdout.decode(errors="replace").splitlines():
+    deadline = time.time() + timeout_s
+    import selectors
+    sel = selectors.DefaultSelector()
+    sel.register(p.stdout, selectors.EVENT_READ)
+    buf = b""
+    try:
+        while True:
+            if p.poll() is not None:
+                buf += p.stdout.read() or b""
+                break
+            if time.time() > deadline:
+                log(f"bench child timed out after {timeout_s:.0f}s")
+                p.kill()
+                p.wait()
+                break
+            if sel.select(timeout=0.5):
+                chunk = os.read(p.stdout.fileno(), 65536)
+                if not chunk:
+                    p.wait()
+                    break
+                buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                line = line.decode(errors="replace").strip()
+                if line.startswith("{") and '"metric"' in line:
+                    best = line
+                    print(line, flush=True)
+    finally:
+        sel.close()
+    for line in buf.decode(errors="replace").splitlines():
         line = line.strip()
         if line.startswith("{") and '"metric"' in line:
-            best = line  # cumulative lines: the last one has everything
-    if best is not None:
-        return best
-    log(f"bench child exited rc={rc} without a JSON line")
-    return None
+            best = line
+            print(line, flush=True)
+    if best is None:
+        log(f"bench child exited rc={p.returncode} without a JSON line")
+    return best
 
 
 def main() -> int:
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
 
-    budget = float(os.environ.get("BENCH_BUDGET_S", 200.0))
+    budget = float(os.environ.get("BENCH_BUDGET_S", 480.0))
     t0 = time.time()
 
     # Attempt 1: ambient platform (real TPU under the driver). The child's
@@ -414,14 +458,15 @@ def main() -> int:
                 timeout_s=left)
 
     if line is None:
-        line = json.dumps({
+        # Nothing measured at all: emit the error line (successful lines
+        # were already streamed by _run_child as they appeared).
+        print(json.dumps({
             "metric": "aggregate_commits_per_sec",
             "value": 0.0,
             "unit": "commits/s",
             "vs_baseline": 0.0,
             "error": "benchmark children timed out (backend init hang?)",
-        })
-    print(line, flush=True)
+        }), flush=True)
     return 0
 
 
