@@ -394,6 +394,22 @@ def _run_child(extra_env: dict, timeout_s: float):
         env=env, stdout=subprocess.PIPE, stderr=None)
     best = None
     deadline = time.time() + timeout_s
+
+    def feed(raw: bytes):
+        """Forward a candidate result line iff it is WHOLE, valid JSON —
+        a kill can leave a truncated tail that must never become the
+        'last matching line' a consumer parses."""
+        nonlocal best
+        line = raw.decode(errors="replace").strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            return
+        try:
+            json.loads(line)
+        except ValueError:
+            return
+        best = line
+        print(line, flush=True)
+
     import selectors
     sel = selectors.DefaultSelector()
     sel.register(p.stdout, selectors.EVENT_READ)
@@ -407,6 +423,7 @@ def _run_child(extra_env: dict, timeout_s: float):
                 log(f"bench child timed out after {timeout_s:.0f}s")
                 p.kill()
                 p.wait()
+                buf += p.stdout.read() or b""  # drain what it got out
                 break
             if sel.select(timeout=0.5):
                 chunk = os.read(p.stdout.fileno(), 65536)
@@ -415,18 +432,12 @@ def _run_child(extra_env: dict, timeout_s: float):
                     break
                 buf += chunk
             while b"\n" in buf:
-                line, buf = buf.split(b"\n", 1)
-                line = line.decode(errors="replace").strip()
-                if line.startswith("{") and '"metric"' in line:
-                    best = line
-                    print(line, flush=True)
+                raw, buf = buf.split(b"\n", 1)
+                feed(raw)
     finally:
         sel.close()
-    for line in buf.decode(errors="replace").splitlines():
-        line = line.strip()
-        if line.startswith("{") and '"metric"' in line:
-            best = line
-            print(line, flush=True)
+    for raw in buf.splitlines():
+        feed(raw)
     if best is None:
         log(f"bench child exited rc={p.returncode} without a JSON line")
     return best
