@@ -120,9 +120,8 @@ def child_main() -> int:
     # --- Phase 1: staggered elections converge in 3 rounds ----------------
     t0 = time.time()
     for r in range(8):
-        st, outbox = kernel.step(cfg, st, inbox, zero, zero,
-                                 jnp.asarray(True))
-        inbox = kernel.route_local(outbox)
+        st, inbox = kernel.step_routed(cfg, st, inbox, zero, zero,
+                                       jnp.asarray(True))
         state = np.asarray(st.state)
         if (np.sum(state == LEADER, axis=1) >= 1).all():
             break
@@ -200,9 +199,8 @@ def child_main() -> int:
                 cum -= cnt
                 pc = jnp.asarray(np.minimum(cnt, cfg.max_ents)
                                  .astype(np.int32))
-            st, outbox = kernel.step(cfg, st, inbox, pc, slots,
-                                     jnp.asarray(True))
-            inbox = kernel.route_local(outbox)
+            st, inbox = kernel.step_routed(cfg, st, inbox, pc, slots,
+                                           jnp.asarray(True))
             if drop is not None:
                 inbox = inbox * drop
             return st, inbox

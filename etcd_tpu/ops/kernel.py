@@ -118,25 +118,27 @@ def _append_noop_and_lead(st: GroupState, cfg: KernelConfig,
 # Phase 1: tick
 # ---------------------------------------------------------------------------
 
-def _tick(st: GroupState, cfg: KernelConfig,
-          active: jax.Array) -> Tuple[GroupState, jax.Array, jax.Array]:
-    """Advance the logical clock one tick for every instance. Returns
+def _tick(st: GroupState, cfg: KernelConfig, active: jax.Array,
+          tick: jax.Array) -> Tuple[GroupState, jax.Array, jax.Array]:
+    """Advance the logical clock one tick for every instance where the
+    scalar `tick` flag is set (masked arithmetic, no lax.cond branch — the
+    cond's per-field copies showed up in the TPU profile). Returns
     (state, hb_fire_term, vote_fire_term): (G, P) int32 arrays holding the
     term at which a heartbeat broadcast / vote broadcast was staged this
     round (0 = none) — the term lets send assembly cancel the broadcast if a
     same-round message bumped us off that term."""
     G, P = st.term.shape
     is_ldr = st.state == LEADER
-    elapsed = st.elapsed + 1
+    elapsed = st.elapsed + tick.astype(jnp.int32)
 
     # Leaders: heartbeat timeout (reference tickHeartbeat raft.go:376-382).
-    hb_timeout = active & is_ldr & (elapsed >= cfg.heartbeat_tick)
+    hb_timeout = tick & active & is_ldr & (elapsed >= cfg.heartbeat_tick)
     hb_fire_term = _where(hb_timeout, st.term, 0)
 
     # Followers/candidates: randomized election timeout (reference
     # tickElection + isElectionTimeout raft.go:362-373,765-771).
     d = elapsed - cfg.election_tick
-    draw = active & ~is_ldr & (d >= 0)
+    draw = tick & active & ~is_ldr & (d >= 0)
     prng = _where(draw, xorshift32(st.prng), st.prng)
     timeout = draw & (d > (prng % jnp.uint32(cfg.election_tick)).astype(jnp.int32))
 
@@ -627,14 +629,7 @@ def step(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     # Age every target's silence counter (clamped; see ack_age docs).
     st = st._replace(ack_age=jnp.minimum(st.ack_age + 1, 1 << 20))
 
-    def do_tick(st):
-        return _tick(st, cfg, active)
-
-    def no_tick(st):
-        z = jnp.zeros_like(st.term)
-        return st, z, z
-
-    st, hb_fire, vote_fire = jax.lax.cond(tick, do_tick, no_tick, st)
+    st, hb_fire, vote_fire = _tick(st, cfg, active, tick)
 
     resp = jnp.zeros((st.term.shape[0], P, P, cfg.fields), jnp.int32)
     for q in range(P):  # unrolled: P is small and static
@@ -653,3 +648,15 @@ def route_local(outbox: jax.Array) -> jax.Array:
     (reference rafthttp/, 4187 lines) collapses to this when peers are
     co-located as array rows."""
     return jnp.swapaxes(outbox, 1, 2)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+def step_routed(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
+                prop_count: jax.Array, prop_slot: jax.Array,
+                tick: jax.Array) -> Tuple[GroupState, jax.Array]:
+    """step + route_local fused into ONE device program: returns
+    (new_state, next_inbox). Saves a dispatch + transpose copy per round
+    for single-host callers that always route locally (bench, engine)."""
+    st, outbox = step.__wrapped__(cfg, st, inbox, prop_count, prop_slot,
+                                  tick)
+    return st, route_local(outbox)
