@@ -447,13 +447,12 @@ class MultiEngine:
                 prop_count[g] = len(batch)
                 prop_slot[g] = s
 
-        # -- 2. the kernel round ------------------------------------------
+        # -- 2. the kernel round (fused step + routing: one dispatch) -----
         tick = (self.round_no % self.cfg.ticks_per_round) == 0
-        st, outbox = kernel.step(
+        st, inbox = kernel.step_routed(
             self.kcfg, self.st, self.inbox,
             jnp.asarray(prop_count), jnp.asarray(prop_slot),
             jnp.asarray(bool(tick)))
-        inbox = kernel.route_local(outbox)
         if self.drop_mask is not None:
             inbox = inbox * self.drop_mask
         self.st = st

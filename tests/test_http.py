@@ -467,3 +467,33 @@ def test_version_monitor_decides_min_and_upgrades(cluster):
         assert srv.cluster_version() == "2.2.0"
     finally:
         srv._get_versions = orig
+
+
+def test_member_update_peer_urls(cluster):
+    """PUT /v2/members/{id} updates a member's advertised peer URLs through
+    consensus (reference UPDATE_NODE ConfChange, client.go:252-286)."""
+    import time as _t
+
+    from etcd_tpu.client import Client, MembersAPI
+
+    m1 = cluster[1]
+    mid = f"{m1.server.id:x}"
+    current = list(m1.peer_urls)
+    extra = current + ["http://127.0.0.1:1"]    # unused alternate URL
+    api = MembersAPI(Client(list(cluster[0].client_urls)))
+    api.update(mid, extra)
+    try:
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            info = [m for m in api.list() if f"{m1.server.id:x}" ==
+                    (m.id if isinstance(m.id, str) else f"{m.id:x}")]
+            if info and sorted(info[0].peer_urls) == sorted(extra):
+                break
+            _t.sleep(0.1)
+        else:
+            raise AssertionError("peer URL update never became visible")
+    finally:
+        # Always restore: the module-scoped cluster serves later tests.
+        api.update(mid, current)
+    st, _, body = req("GET", cluster[0].client_urls[0] + "/v2/members")
+    assert st == 200
