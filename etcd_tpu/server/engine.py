@@ -82,6 +82,11 @@ class EngineConfig:
     ticks_per_round: int = 1          # logical clock rate
     stagger: bool = True              # deterministic fast first election
     initial_peers: Optional[int] = None  # active slots at fresh boot (<= peers)
+    # Optional jax.sharding.Mesh with ("groups", "peers") axes
+    # (parallel/mesh.py): the kernel state shards over it and the per-round
+    # message routing becomes an all_to_all over the "peers" mesh axis —
+    # the multi-chip serving path. None = single-device arrays.
+    mesh: Any = None
 
 
 class MultiEngine:
@@ -103,6 +108,25 @@ class MultiEngine:
             max_ents=cfg.max_ents, election_tick=cfg.election_tick,
             heartbeat_tick=cfg.heartbeat_tick)
         G, P, W = cfg.groups, cfg.peers, cfg.window
+
+        # Mesh placement: pinned out_shardings keep the state AND the routed
+        # inbox on their canonical shardings round over round (one compile;
+        # the outbox->inbox peer-axis swap lowers to an all_to_all over the
+        # "peers" mesh axis — the ICI transport of SURVEY §2.4).
+        self._st_sh = self._mb_sh = None
+        if cfg.mesh is not None:
+            import functools
+            from etcd_tpu.parallel.mesh import (mailbox_sharding,
+                                                state_sharding)
+            self._st_sh = state_sharding(cfg.mesh)
+            self._mb_sh = mailbox_sharding(cfg.mesh)
+            self._step_fn = jax.jit(
+                functools.partial(kernel.step_routed.__wrapped__, self.kcfg),
+                donate_argnums=(0, 1),
+                out_shardings=(self._st_sh, self._mb_sh))
+        else:
+            self._step_fn = lambda st, inbox, pc, ps, t: kernel.step_routed(
+                self.kcfg, st, inbox, pc, ps, t)
 
         self.wal = EngineWAL(cfg.data_dir, fsync=cfg.fsync)
         self.wait = Wait()
@@ -138,11 +162,26 @@ class MultiEngine:
             self.st = init_state(self.kcfg, n_peers=cfg.initial_peers,
                                  stagger=cfg.stagger)
             self.h_mask = np.asarray(self.st.peer_mask).copy()
-        self.inbox = jnp.zeros((G, P, P, self.kcfg.fields), jnp.int32)
+        if self._st_sh is not None:
+            from etcd_tpu.parallel.mesh import shard_state
+            self.st = shard_state(self.st, cfg.mesh)
+        inbox0 = jnp.zeros((G, P, P, self.kcfg.fields), jnp.int32)
+        self.inbox = (jax.device_put(inbox0, self._mb_sh)
+                      if self._mb_sh is not None else inbox0)
         self._zero = jnp.zeros(G, jnp.int32)
         # Chaos hook: (G, P_to, P_from, 1)-broadcastable 0/1 mask applied to
         # the routed inbox (tests inject drops/partitions here).
         self.drop_mask = None
+
+    def _dev(self, name: str, arr) -> Any:
+        """Host array -> device, on the field's canonical sharding when a
+        mesh is configured (host-surgery writebacks must not knock fields
+        off their sharding, or the pinned-sharding step would silently
+        reshard every round)."""
+        x = self._jnp.asarray(arr)
+        if self._st_sh is not None:
+            x = self._jax.device_put(x, getattr(self._st_sh, name))
+        return x
 
     # ------------------------------------------------------------------
     # restore
@@ -449,8 +488,8 @@ class MultiEngine:
 
         # -- 2. the kernel round (fused step + routing: one dispatch) -----
         tick = (self.round_no % self.cfg.ticks_per_round) == 0
-        st, inbox = kernel.step_routed(
-            self.kcfg, self.st, self.inbox,
+        st, inbox = self._step_fn(
+            self.st, self.inbox,
             jnp.asarray(prop_count), jnp.asarray(prop_slot),
             jnp.asarray(bool(tick)))
         if self.drop_mask is not None:
@@ -659,18 +698,17 @@ class MultiEngine:
         """Flip a membership bit at a committed boundary and reset the
         affected progress/vote columns (reference raft.go addNode/
         removeNode + multinode.go:181-218)."""
-        jnp = self._jnp
         add = (op == "add")
         self.h_mask[g, slot] = add
-        mask = jnp.asarray(self.h_mask)
+        mask = self._dev("peer_mask", self.h_mask)
 
         st = self.st
         if add:
             # Fresh empty follower state in the slot.
-            def zero_at(a):
+            def zero_at(name, a):
                 arr = np.asarray(a).copy()
                 arr[g, slot] = 0
-                return jnp.asarray(arr)
+                return self._dev(name, arr)
 
             ring = np.asarray(st.log_term).copy()
             ring[g, slot] = 0
@@ -686,13 +724,18 @@ class MultiEngine:
             votes[g, :, slot] = 0
             self.st = st._replace(
                 peer_mask=mask,
-                term=zero_at(st.term), vote=zero_at(st.vote),
-                commit=zero_at(st.commit), lead=zero_at(st.lead),
-                state=zero_at(st.state), elapsed=zero_at(st.elapsed),
-                last_index=zero_at(st.last_index),
-                log_term=jnp.asarray(ring), next=jnp.asarray(nxt),
-                match=jnp.asarray(match), pr_state=jnp.asarray(prs),
-                paused=jnp.asarray(paused), votes=jnp.asarray(votes))
+                term=zero_at("term", st.term), vote=zero_at("vote", st.vote),
+                commit=zero_at("commit", st.commit),
+                lead=zero_at("lead", st.lead),
+                state=zero_at("state", st.state),
+                elapsed=zero_at("elapsed", st.elapsed),
+                last_index=zero_at("last_index", st.last_index),
+                log_term=self._dev("log_term", ring),
+                next=self._dev("next", nxt),
+                match=self._dev("match", match),
+                pr_state=self._dev("pr_state", prs),
+                paused=self._dev("paused", paused),
+                votes=self._dev("votes", votes))
             self.h_ring[g, slot] = 0
             self.h_last[g, slot] = 0
             self.h_term[g, slot] = 0
@@ -706,8 +749,9 @@ class MultiEngine:
             stat[g, slot] = 0
             lead = np.asarray(st.lead).copy()
             lead[g, slot] = 0
-            self.st = st._replace(peer_mask=mask, state=jnp.asarray(stat),
-                                  lead=jnp.asarray(lead))
+            self.st = st._replace(peer_mask=mask,
+                                  state=self._dev("state", stat),
+                                  lead=self._dev("lead", lead))
             self.h_state[g, slot] = 0
 
     def _service_need_host(self, need_host: np.ndarray) -> None:
@@ -780,18 +824,22 @@ class MultiEngine:
         nh = np.zeros_like(need_host)
         if touched:
             self.st = st._replace(
-                term=jnp.asarray(term), vote=jnp.asarray(vote),
-                commit=jnp.asarray(commit), last_index=jnp.asarray(lastv),
-                log_term=jnp.asarray(ring), lead=jnp.asarray(lead),
-                state=jnp.asarray(stat), elapsed=jnp.asarray(elapsed),
-                match=jnp.asarray(match), next=jnp.asarray(nxt),
-                pr_state=jnp.asarray(prs), paused=jnp.asarray(paused),
-                need_host=jnp.asarray(nh))
+                term=self._dev("term", term), vote=self._dev("vote", vote),
+                commit=self._dev("commit", commit),
+                last_index=self._dev("last_index", lastv),
+                log_term=self._dev("log_term", ring),
+                lead=self._dev("lead", lead),
+                state=self._dev("state", stat),
+                elapsed=self._dev("elapsed", elapsed),
+                match=self._dev("match", match), next=self._dev("next", nxt),
+                pr_state=self._dev("pr_state", prs),
+                paused=self._dev("paused", paused),
+                need_host=self._dev("need_host", nh))
             # NOTE: the h_* mirrors deliberately KEEP their pre-surgery
             # values — the next round's WAL diff then records the install's
             # term/commit/ring/last changes, making it durable.
         else:
-            self.st = st._replace(need_host=jnp.asarray(nh))
+            self.st = st._replace(need_host=self._dev("need_host", nh))
 
     # ------------------------------------------------------------------
     # checkpoint

@@ -168,11 +168,11 @@ def test_full_member_rotation(tmp_path):
 
     peers = {f"m{i}": [purl[i]] for i in range(3)}
     live = {}
-    for i in range(3):
-        m = Etcd(_cfg(tmp_path, f"m{i}", peers, ports[6 + i]))
-        m.start()
-        live[f"m{i}"] = m
     try:
+        for i in range(3):
+            m = Etcd(_cfg(tmp_path, f"m{i}", peers, ports[6 + i]))
+            live[f"m{i}"] = m   # registered first: finally must stop it
+            m.start()
         assert any(m.wait_leader(15) for m in live.values())
         seed_api = KeysAPI(Client([u for m in live.values()
                                    for u in m.client_urls]))
@@ -189,8 +189,8 @@ def test_full_member_rotation(tmp_path):
             grown[new_name] = [purl[i]]
             m = Etcd(_cfg(tmp_path, new_name, grown, ports[6 + i],
                           initial_cluster_state="existing"))
-            m.start()
             live[new_name] = m   # registered first: finally must stop it
+            m.start()
             assert m.wait_leader(20), f"{new_name} never saw a leader"
 
             # 2. wait until the joiner serves the seed, then remove an old

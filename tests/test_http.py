@@ -493,7 +493,19 @@ def test_member_update_peer_urls(cluster):
         else:
             raise AssertionError("peer URL update never became visible")
     finally:
-        # Always restore: the module-scoped cluster serves later tests.
+        # Always restore: the module-scoped cluster serves later tests —
+        # and WAIT for the restore to be visible (the update above needed
+        # the same poll, so leaving early could expose the bogus URL to a
+        # later test).
         api.update(mid, current)
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            info = [m for m in api.list() if f"{m1.server.id:x}" ==
+                    (m.id if isinstance(m.id, str) else f"{m.id:x}")]
+            if info and sorted(info[0].peer_urls) == sorted(current):
+                break
+            _t.sleep(0.1)
+        else:
+            raise AssertionError("peer URL restore never became visible")
     st, _, body = req("GET", cluster[0].client_urls[0] + "/v2/members")
     assert st == 200
