@@ -102,6 +102,10 @@ class ClientAPI:
     def _error(self, ctx: Ctx, err: errors.EtcdError) -> None:
         if not err.index:
             err.index = self.server.store.current_index
+        # The internal store prefix must not leak into user-visible causes
+        # (reference trimErrorPrefix, client.go:142,622-626).
+        if err.cause.startswith(STORE_KEYS_PREFIX):
+            err.cause = err.cause[len(STORE_KEYS_PREFIX):]
         ctx.send(err.status_code, err.to_json().encode() + b"\n",
                  "application/json", self._headers(err.index))
 

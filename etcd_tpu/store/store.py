@@ -440,8 +440,15 @@ class Store:
         index_ok = (prev_index == 0) or (n.modified_index == prev_index)
         if value_ok and index_ok:
             return
-        cause = (f"[{prev_value} != {n.value or ''}] "
-                 f"[{prev_index} != {n.modified_index}]")
+        # Only the failing clause(s) appear (reference getCompareFailCause,
+        # store/store.go:196-206): index-only, value-only, or both.
+        if value_ok:
+            cause = f"[{prev_index} != {n.modified_index}]"
+        elif index_ok:
+            cause = f"[{prev_value} != {n.value or ''}]"
+        else:
+            cause = (f"[{prev_value} != {n.value or ''}] "
+                     f"[{prev_index} != {n.modified_index}]")
         raise errors.EtcdError(errors.ECODE_TEST_FAILED, cause=cause,
                                index=self.current_index)
 

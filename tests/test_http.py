@@ -469,10 +469,23 @@ def test_version_monitor_decides_min_and_upgrades(cluster):
         srv._get_versions = orig
 
 
+def _wait_peer_urls(api, hexid, want, timeout=10.0):
+    """Poll the members API until the member's peer URLs equal `want`."""
+    import time as _t
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        info = [m for m in api.list() if hexid ==
+                (m.id if isinstance(m.id, str) else f"{m.id:x}")]
+        if info and sorted(info[0].peer_urls) == sorted(want):
+            return True
+        _t.sleep(0.1)
+    return False
+
+
 def test_member_update_peer_urls(cluster):
     """PUT /v2/members/{id} updates a member's advertised peer URLs through
     consensus (reference UPDATE_NODE ConfChange, client.go:252-286)."""
-    import time as _t
+    import sys as _sys
 
     from etcd_tpu.client import Client, MembersAPI
 
@@ -483,29 +496,15 @@ def test_member_update_peer_urls(cluster):
     api = MembersAPI(Client(list(cluster[0].client_urls)))
     api.update(mid, extra)
     try:
-        deadline = _t.time() + 10
-        while _t.time() < deadline:
-            info = [m for m in api.list() if f"{m1.server.id:x}" ==
-                    (m.id if isinstance(m.id, str) else f"{m.id:x}")]
-            if info and sorted(info[0].peer_urls) == sorted(extra):
-                break
-            _t.sleep(0.1)
-        else:
-            raise AssertionError("peer URL update never became visible")
+        assert _wait_peer_urls(api, mid, extra), \
+            "peer URL update never became visible"
     finally:
-        # Always restore: the module-scoped cluster serves later tests —
-        # and WAIT for the restore to be visible (the update above needed
-        # the same poll, so leaving early could expose the bogus URL to a
-        # later test).
+        # Always restore and WAIT for visibility: the module-scoped cluster
+        # serves later tests. Only raise if the try body succeeded — a
+        # restore raise here would mask the primary failure.
         api.update(mid, current)
-        deadline = _t.time() + 10
-        while _t.time() < deadline:
-            info = [m for m in api.list() if f"{m1.server.id:x}" ==
-                    (m.id if isinstance(m.id, str) else f"{m.id:x}")]
-            if info and sorted(info[0].peer_urls) == sorted(current):
-                break
-            _t.sleep(0.1)
-        else:
+        restored = _wait_peer_urls(api, mid, current)
+        if not restored and _sys.exc_info()[0] is None:
             raise AssertionError("peer URL restore never became visible")
     st, _, body = req("GET", cluster[0].client_urls[0] + "/v2/members")
     assert st == 200
