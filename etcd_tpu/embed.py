@@ -100,12 +100,15 @@ class Etcd:
         # v2 security gate + /v2/security routes wired in.
         from etcd_tpu.etcdhttp.client_security import SecurityHandler
         self.client_http = []
+        from etcd_tpu.etcdhttp.v3 import V3API
         self.security = SecurityHandler(self.server)
         self.client_api = ClientAPI(self.server, security=self.security)
+        self.v3_api = V3API(self.server, security=self.security)
         for url in client_urls:
             router = Router()
             self.client_api.install(router)
             self.security.install(router)
+            self.v3_api.install(router)
             host, port = _listen_addr(url)
             # CORS wraps only the CLIENT mux (reference etcd.go:218-229).
             self.client_http.append(

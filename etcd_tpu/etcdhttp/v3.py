@@ -1,0 +1,103 @@
+"""HTTP gateway for the v3 KV preview (reference Documentation/rfc/
+v3api.md + v3api.proto Range/Put/DeleteRange/Txn/Compact rpcs, served the
+etcd JSON-gateway way: POST with a JSON body, bytes fields base64).
+
+    POST /v3/kv/range        RangeRequest   -> RangeResponse
+    POST /v3/kv/put          PutRequest     -> PutResponse
+    POST /v3/kv/deleterange  DeleteRangeRequest -> DeleteRangeResponse
+    POST /v3/kv/txn          TxnRequest     -> TxnResponse
+    POST /v3/kv/compact      CompactionRequest -> CompactionResponse
+    POST /v3/watch, /v3/lease/*   501 (declared by the RFC, implementation
+                                  pending — the reference implements neither)
+
+Mutations (and linearizable ranges) ride the member's consensus log as
+METHOD_V3 requests; serializable ranges (`"serializable": true`) read the
+local kvstore directly.
+"""
+from __future__ import annotations
+
+import json
+
+from etcd_tpu import errors
+from etcd_tpu.etcdhttp.web import Ctx, Router
+from etcd_tpu.server.request import METHOD_V3, Request
+from etcd_tpu.server.v3 import V3Error, validate_op
+
+V3_PREFIX = "/v3"
+
+
+class V3API:
+    def __init__(self, server, security=None) -> None:
+        self.server = server
+        self.security = security
+
+    def install(self, router: Router) -> None:
+        router.add(V3_PREFIX + "/", self.handle)
+
+    def handle(self, ctx: Ctx, suffix: str) -> None:
+        if ctx.method != "POST":
+            ctx.send(405, b"Method Not Allowed", headers={"Allow": "POST"})
+            return
+        # v2 auth has no v3 user model, so when security is enabled the
+        # whole v3 preview surface requires root credentials — the same
+        # listener must not offer an unauthenticated write path (the
+        # admin-ops rule, reference client_security.go hasRootAccess).
+        if self.security is not None and not self.security.has_root_access(
+                ctx):
+            ctx.send(401, b'{"error": "Insufficient credentials", '
+                          b'"code": 16}\n', "application/json",
+                     {"WWW-Authenticate": 'Basic realm="etcd"'})
+            return
+        try:
+            body = json.loads(ctx.body.decode() or "{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._err(ctx, 400, 3, f"bad request body: {e}")
+            return
+        route = {
+            "kv/range": "range", "kv/put": "put",
+            "kv/deleterange": "deleterange", "kv/txn": "txn",
+            "kv/compact": "compact",
+        }.get(suffix)
+        if route is None:
+            if suffix == "watch" or suffix.startswith("lease"):
+                self._err(ctx, 501, 12,
+                          f"v3 {suffix.split('/')[0]} is declared by the "
+                          "RFC but not yet implemented")
+            else:
+                self._err(ctx, 404, 3, f"unknown v3 path {suffix!r}")
+            return
+        op = dict(body)
+        op["type"] = route
+        try:
+            # Reject malformed ops HERE — nothing unvalidated may enter
+            # the consensus log (apply re-validates; defense in depth).
+            validate_op(op)
+            if route == "range" and body.get("serializable"):
+                result = self.server.v3.range(op)
+            else:
+                if route == "range":
+                    op["linearizable"] = True
+                result = self.server.do(Request(method=METHOD_V3, v3=op))
+        except V3Error as e:
+            self._v3err(ctx, e)
+            return
+        except errors.EtcdError as e:
+            self._err(ctx, e.status_code, 13, e.message)
+            return
+        except (KeyError, ValueError, TypeError) as e:
+            self._err(ctx, 400, 3, f"bad v3 request: {e}")
+            return
+        if isinstance(result, V3Error):   # deterministic apply-side error
+            self._v3err(ctx, result)
+            return
+        ctx.send_json(200, result)
+
+    def _v3err(self, ctx: Ctx, e: V3Error) -> None:
+        # grpc code 11 = OutOfRange (compacted), 3 = InvalidArgument.
+        status = {11: 400, 3: 400, 12: 501}.get(e.code, 400)
+        self._err(ctx, status, e.code, e.msg)
+
+    def _err(self, ctx: Ctx, status: int, code: int, msg: str) -> None:
+        ctx.send_json(status, {"error": msg, "code": code})

@@ -18,6 +18,7 @@ METHOD_POST = "POST"
 METHOD_DELETE = "DELETE"
 METHOD_QGET = "QGET"
 METHOD_SYNC = "SYNC"
+METHOD_V3 = "V3"        # v3 op (the `v3` field) through the same log
 
 
 @dataclass(frozen=True)
@@ -39,6 +40,7 @@ class Request:
     stream: bool = False
     time: float = 0.0                   # SYNC: the leader's cutoff timestamp
     refresh: bool = False               # TTL refresh without value change
+    v3: Optional[dict] = None           # METHOD_V3 payload (server/v3.py)
 
     def encode(self) -> bytes:
         d = {k: v for k, v in asdict(self).items()

@@ -35,6 +35,7 @@ from etcd_tpu.server import cluster as cl
 from etcd_tpu.server.cluster import Cluster, Member, STORE_KEYS_PREFIX
 from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, METHOD_QGET, METHOD_SYNC,
+                                     METHOD_V3,
                                      Request)
 from etcd_tpu.server.stats import LeaderStats, ServerStats
 from etcd_tpu.server.storage import ServerStorage, read_wal
@@ -111,6 +112,12 @@ class EtcdServer:
         touch_dir_all(cfg.snapdir)
         self.snapshotter = Snapshotter(cfg.snapdir)
         self.raft_storage = MemoryStorage()
+        # v3 MVCC preview keyspace (server/v3.py): replicated through the
+        # same log; per-member sqlite backend under member/v3.
+        from etcd_tpu.server.v3 import V3Applier
+        touch_dir_all(os.path.join(cfg.data_dir, "member", "v3"))
+        self.v3 = V3Applier(
+            os.path.join(cfg.data_dir, "member", "v3", "kv.db"))
         self._applied = 0
         self._snapi = 0
         self.wait = Wait()
@@ -341,6 +348,7 @@ class EtcdServer:
             self._thread.join(timeout=5)
         self.transport.stop()
         self.storage.close()
+        self.v3.close()
 
     @property
     def stopped(self) -> bool:
@@ -358,8 +366,12 @@ class EtcdServer:
                 return self.store.watch(r.path, r.recursive, r.stream, r.since)
             else:
                 return self.store.get(r.path, r.recursive, r.sorted)
+        if r.method == METHOD_V3 and r.v3 and r.v3.get("type") == "range" \
+                and not r.v3.get("linearizable"):
+            # Serializable v3 read: straight off the local kvstore.
+            return self.v3.range(r.v3)
         if r.method in (METHOD_PUT, METHOD_POST, METHOD_DELETE, METHOD_QGET,
-                        METHOD_SYNC):
+                        METHOD_SYNC, METHOD_V3):
             if r.id == 0:
                 r = raftpb.replace(r, id=self.reqid.next())
             q = self.wait.register(r.id)
@@ -675,14 +687,32 @@ class EtcdServer:
             return  # leader's empty commit marker
         r = Request.decode(e.data)
         try:
-            result = self._apply_request(r)
+            result = self._apply_request(r, e.index)
         except errors.EtcdError as err:
             result = err
         self.wait.trigger(r.id, result)
 
-    def _apply_request(self, r: Request):
+    def _apply_request(self, r: Request, index: int = 0):
         """Deterministic request→store mapping (reference applyRequest
-        server.go:766-820)."""
+        server.go:766-820). v3 ops carry the entry index so the v3
+        consistent-index can make replay idempotent."""
+        from etcd_tpu.server.v3 import V3Error
+        if r.method == METHOD_V3:
+            try:
+                return self.v3.apply(r.v3 or {}, index)
+            except V3Error as e:
+                return e   # deterministic; delivered to the waiter as-is
+            except Exception:
+                # Environmental failure (disk I/O, sqlite corruption): the
+                # apply did NOT record its consistent index and nothing
+                # committed (atomic hold), so crashing this member and
+                # re-applying on restart is the consistent outcome — the
+                # reference panics on backend errors for the same reason.
+                # Deterministic data errors can't land here: validate_op
+                # turns them into V3Errors on every member identically.
+                log.exception("fatal: v3 apply failed at index %d; "
+                              "stopping applies on this member", index)
+                raise
         st = self.store
         exp = r.expiration
         if r.method == METHOD_POST:
@@ -765,6 +795,12 @@ class EtcdServer:
         server.go:476-480,876-916)."""
         if self._applied - self._snapi <= self.cfg.snap_count:
             return
+        # The snapshot advances the WAL-replay floor past every applied
+        # entry, so the v3 backend's pending batch (data + consistent
+        # index) must be durable FIRST — otherwise a crash inside the
+        # batch interval loses v3 ops in (consistentIndex, snapshot] with
+        # no replay to recover them.
+        self.v3.kv.b.force_commit()
         data = self.store.save()
         cs = ConfState(nodes=tuple(self.node.raft.nodes()))
         snap = self.raft_storage.create_snapshot(self._applied, cs, data)

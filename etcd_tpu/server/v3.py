@@ -1,0 +1,317 @@
+"""v3 ops through consensus: the serving half of the v3 MVCC preview.
+
+The reference at this vintage ships the v3 RFC (Documentation/rfc/v3api.md,
+v3api.proto: Range/Put/DeleteRange/Txn/Compact) and the embryonic storage/
+package, but never wires them into etcdserver. This module closes that gap
+the way etcd later did: every v3 mutation is a consensus entry, applied
+deterministically to a per-member KVStore, with a **consistent index**
+recorded transactionally alongside each apply so WAL replay after a crash
+never double-applies (double-apply would fork the revision sequence between
+members — the exact bug etcd v3's consistentIndex exists to prevent).
+
+Known preview limitation (documented in docs/divergences.md): the v2
+snapshot does not carry the v3 keyspace, so a follower that catches up via
+snapshot-install resumes v3 ops only from its own consistent index. The
+reference has no v3 serving at all, so there is no behavior to diverge
+from; single-member restarts and normal WAL catch-up are fully covered.
+
+Op / response shapes follow the RFC proto messages with the etcd JSON
+gateway convention: `key`/`value`/`range_end` are base64 strings.
+"""
+from __future__ import annotations
+
+import base64
+import struct
+from typing import Any, Dict, List, Optional
+
+from etcd_tpu.storage import CompactedError, KVStore
+from etcd_tpu.storage.kvstore import META_BUCKET
+
+CONSISTENT_INDEX_KEY = b"consistentIndex"
+
+# Compare targets / results (v3api.proto Compare).
+_TARGETS = ("VERSION", "CREATE", "MOD", "VALUE")
+_RESULTS = ("EQUAL", "GREATER", "LESS")
+
+
+def b64e(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def b64d(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class V3Error(Exception):
+    """Maps to an HTTP error payload at the gateway."""
+
+    def __init__(self, code: int, msg: str) -> None:
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+def _need_b64(op: Dict[str, Any], field: str, required: bool) -> None:
+    v = op.get(field)
+    if v is None:
+        if required:
+            raise V3Error(3, f"missing required field {field!r}")
+        return
+    if not isinstance(v, str):
+        raise V3Error(3, f"field {field!r} must be a base64 string")
+    try:
+        base64.b64decode(v, validate=True)
+    except Exception:
+        raise V3Error(3, f"field {field!r} is not valid base64")
+
+
+def _need_int(op: Dict[str, Any], field: str) -> None:
+    v = op.get(field)
+    if v is None:
+        return
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise V3Error(3, f"field {field!r} must be an integer")
+
+
+def validate_op(op: Dict[str, Any]) -> None:
+    """Structural validation of a v3 op. Runs at the GATEWAY (so malformed
+    requests are rejected before they enter the consensus log) and again at
+    apply time (so a replicated op can never throw a decode error out of
+    the apply thread — it is a pure function of the op dict, hence
+    deterministic across members and replays)."""
+    t = op.get("type")
+    if t in ("put",):
+        _need_b64(op, "key", required=True)
+        _need_b64(op, "value", required=False)
+    elif t in ("range", "deleterange"):
+        _need_b64(op, "key", required=True)
+        _need_b64(op, "range_end", required=False)
+        _need_int(op, "limit")
+        _need_int(op, "revision")
+    elif t == "compact":
+        _need_int(op, "revision")
+    elif t == "txn":
+        for c in _need_list(op, "compare"):
+            if not isinstance(c, dict):
+                raise V3Error(3, "compare entries must be objects")
+            if c.get("target", "VALUE") not in _TARGETS or \
+                    c.get("result", "EQUAL") not in _RESULTS:
+                raise V3Error(3, f"bad compare {c!r}")
+            _need_b64(c, "key", required=True)
+            _need_b64(c, "value", required=False)
+            for f in ("version", "create_revision", "mod_revision"):
+                _need_int(c, f)
+        for branch in ("success", "failure"):
+            for r in _need_list(op, branch):
+                if not isinstance(r, dict) or len(r) != 1:
+                    raise V3Error(
+                        3, "txn requests must hold exactly one of "
+                           "request_put/request_range/request_delete_range")
+                kind, p = next(iter(r.items()))
+                if kind == "request_put":
+                    validate_op({**p, "type": "put"})
+                elif kind == "request_range":
+                    validate_op({**p, "type": "range"})
+                elif kind == "request_delete_range":
+                    validate_op({**p, "type": "deleterange"})
+                else:
+                    raise V3Error(3, f"unknown txn request {kind!r}")
+    else:
+        raise V3Error(3, f"unknown v3 op type {t!r}")
+
+
+def _need_list(op: Dict[str, Any], field: str) -> List[Any]:
+    v = op.get(field, [])
+    if not isinstance(v, list):
+        raise V3Error(3, f"field {field!r} must be a list")
+    return v
+
+
+class V3Applier:
+    """Deterministic v3 op application over one member's KVStore."""
+
+    def __init__(self, path: str) -> None:
+        self.kv = KVStore(path)
+        self.consistent_index = 0
+        with self.kv.b.batch_tx as tx:
+            _, vs = tx.unsafe_range(META_BUCKET, CONSISTENT_INDEX_KEY)
+        if vs:
+            self.consistent_index = struct.unpack(">Q", vs[0])[0]
+
+    def close(self) -> None:
+        self.kv.close()
+
+    # -- reads (serializable; linearizable reads ride apply()) --------------
+
+    def range(self, op: Dict[str, Any]) -> Dict[str, Any]:
+        key = b64d(op.get("key", ""))
+        end = b64d(op["range_end"]) if op.get("range_end") else None
+        limit = int(op.get("limit", 0))
+        rev = int(op.get("revision", 0))
+        try:
+            kvs, cur = self.kv.range(key, end, limit=limit, range_rev=rev)
+        except CompactedError as e:
+            raise V3Error(11, f"required revision {e.args[0]} has been "
+                              "compacted")
+        more = bool(limit) and len(kvs) == limit
+        return {
+            "header": {"revision": cur},
+            "kvs": [self._kv_json(kv) for kv in kvs],
+            "count": len(kvs),
+            "more": more,
+        }
+
+    @staticmethod
+    def _kv_json(kv) -> Dict[str, Any]:
+        return {"key": b64e(kv.key), "value": b64e(kv.value),
+                "create_revision": kv.create_rev,
+                "mod_revision": kv.mod_rev, "version": kv.version}
+
+    # -- the replicated apply ----------------------------------------------
+
+    def apply(self, op: Dict[str, Any], index: int) -> Dict[str, Any]:
+        """Apply one committed v3 op at raft entry `index`. Idempotent:
+        entries at or below the consistent index were already applied in a
+        previous life and are skipped (reference-future consistentIndex
+        semantics).
+
+        The whole apply runs inside batch_tx.hold(): the mutation and the
+        consistent-index record land in ONE sqlite commit, so a crash can
+        never persist one without the other (a split would double-apply on
+        replay and fork the revision sequence between members)."""
+        if index <= self.consistent_index:
+            return {"skipped": True, "header":
+                    {"revision": self.kv.current_rev.main}}
+        validate_op(op)       # deterministic; malformed ops error, don't
+        #                       kill the apply thread
+        if op.get("type") == "range":
+            # Read-only: replaying a range is harmless, so it needs no
+            # consistent-index record — recording one would turn every
+            # linearizable read into a durable write on every member.
+            return self.range(op)
+        with self.kv.atomic() as tx:
+            try:
+                result = self._dispatch(op)
+            except V3Error:
+                # Deterministic outcome (a pure function of op + store
+                # state): every member and every replay resolves it
+                # identically, so the index advances. No mutation has
+                # executed when a V3Error is raised (all checks precede
+                # writes; txn requests are pre-validated).
+                self._record_index(tx, index)
+                raise
+            except Exception:
+                # Environmental (disk I/O, corruption): discard the whole
+                # un-committed batch so the timer can't durably commit a
+                # half-applied op after the apply thread dies, skip the
+                # index record, and let the caller crash the member —
+                # restart replays the entry from the last commit boundary.
+                self.kv.b.rollback()
+                raise
+            self._record_index(tx, index)
+        return result
+
+    def _record_index(self, tx, index: int) -> None:
+        self.consistent_index = index
+        tx.unsafe_put(META_BUCKET, CONSISTENT_INDEX_KEY,
+                      struct.pack(">Q", index))
+
+    def _dispatch(self, op: Dict[str, Any]) -> Dict[str, Any]:
+        t = op.get("type")
+        if t == "put":
+            rev = self.kv.put(b64d(op["key"]), b64d(op.get("value", "")))
+            return {"header": {"revision": rev}}
+        if t == "deleterange":
+            end = b64d(op["range_end"]) if op.get("range_end") else None
+            n, rev = self.kv.delete_range(b64d(op["key"]), end)
+            return {"header": {"revision": rev}, "deleted": n}
+        if t == "range":   # linearizable read: rides the apply stream
+            return self.range(op)
+        if t == "compact":
+            rev = int(op.get("revision", 0))
+            try:
+                self.kv.compact(rev)
+            except CompactedError:
+                raise V3Error(11, f"revision {rev} has been compacted")
+            except ValueError as e:
+                raise V3Error(3, str(e))
+            return {"header": {"revision": self.kv.current_rev.main}}
+        if t == "txn":
+            return self._apply_txn(op)
+        raise V3Error(3, f"unknown v3 op type {t!r}")
+
+    # -- txn ----------------------------------------------------------------
+
+    def _apply_txn(self, op: Dict[str, Any]) -> Dict[str, Any]:
+        succeeded = all(self._check(c) for c in op.get("compare", []))
+        reqs: List[Dict[str, Any]] = op.get(
+            "success" if succeeded else "failure", [])
+        # Atomicity: every error a request can raise must be raised BEFORE
+        # txn_begin (validate_op covers structure; a compacted range
+        # revision is the remaining data-dependent case) — a mid-txn error
+        # would commit a partial txn, and etcd txns are all-or-nothing.
+        for r in reqs:
+            if "request_range" in r:
+                rr = int(r["request_range"].get("revision", 0))
+                if 0 < rr <= self.kv.compact_main_rev:
+                    raise V3Error(11, f"required revision {rr} has been "
+                                      "compacted")
+        tid = self.kv.txn_begin()
+        responses = []
+        try:
+            for r in reqs:
+                if "request_put" in r:
+                    p = r["request_put"]
+                    rev = self.kv.txn_put(tid, b64d(p["key"]),
+                                          b64d(p.get("value", "")))
+                    responses.append(
+                        {"response_put": {"header": {"revision": rev}}})
+                elif "request_delete_range" in r:
+                    p = r["request_delete_range"]
+                    end = (b64d(p["range_end"])
+                           if p.get("range_end") else None)
+                    n, rev = self.kv.txn_delete_range(tid, b64d(p["key"]),
+                                                      end)
+                    responses.append({"response_delete_range":
+                                      {"header": {"revision": rev},
+                                       "deleted": n}})
+                elif "request_range" in r:
+                    p = r["request_range"]
+                    end = (b64d(p["range_end"])
+                           if p.get("range_end") else None)
+                    kvs, cur = self.kv.txn_range(
+                        tid, b64d(p["key"]), end,
+                        limit=int(p.get("limit", 0)),
+                        range_rev=int(p.get("revision", 0)))
+                    responses.append({"response_range": {
+                        "header": {"revision": cur},
+                        "kvs": [self._kv_json(kv) for kv in kvs],
+                        "count": len(kvs)}})
+                else:
+                    raise V3Error(3, f"unknown txn request {r!r}")
+        finally:
+            self.kv.txn_end(tid)
+        return {"header": {"revision": self.kv.current_rev.main},
+                "succeeded": succeeded, "responses": responses}
+
+    def _check(self, c: Dict[str, Any]) -> bool:
+        target = c.get("target", "VALUE")
+        result = c.get("result", "EQUAL")
+        if target not in _TARGETS or result not in _RESULTS:
+            raise V3Error(3, f"bad compare {c!r}")
+        kvs, _ = self.kv.range(b64d(c["key"]))
+        if target == "VALUE":
+            have: Any = kvs[0].value if kvs else b""
+            want: Any = b64d(c.get("value", ""))
+        else:
+            have = {"VERSION": kvs[0].version if kvs else 0,
+                    "CREATE": kvs[0].create_rev if kvs else 0,
+                    "MOD": kvs[0].mod_rev if kvs else 0}[target]
+            want = int(c.get({"VERSION": "version",
+                              "CREATE": "create_revision",
+                              "MOD": "mod_revision"}[target], 0))
+        if result == "EQUAL":
+            return have == want
+        if result == "GREATER":
+            return have > want
+        return have < want

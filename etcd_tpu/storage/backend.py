@@ -14,6 +14,7 @@ embryonic v3 has no read-only snapshot txs yet).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import sqlite3
 import threading
@@ -33,12 +34,14 @@ def _table(bucket: bytes) -> str:
 
 class BatchTx:
     """The single write transaction; take .lock around Unsafe* calls
-    (reference batch_tx.go)."""
+    (reference batch_tx.go). The lock is re-entrant so a caller can hold()
+    it across several Unsafe* groups to make them one atomic commit unit."""
 
     def __init__(self, backend: "Backend") -> None:
-        self.lock = threading.Lock()
+        self.lock = threading.RLock()
         self._b = backend
         self._pending = 0
+        self._hold = 0
 
     def __enter__(self):
         self.lock.acquire()
@@ -46,6 +49,22 @@ class BatchTx:
 
     def __exit__(self, *exc):
         self.lock.release()
+
+    @contextlib.contextmanager
+    def hold(self):
+        """Atomic section: while held, nothing can commit — not the timer
+        (blocked on the re-entrant lock) and not the batch-limit flush
+        (suppressed) — so every write inside lands in ONE sqlite commit.
+        Used by the v3 apply path to bind a mutation to its consistent
+        index: committing one without the other would double-apply on
+        replay."""
+        self.lock.acquire()
+        self._hold += 1
+        try:
+            yield self
+        finally:
+            self._hold -= 1
+            self.lock.release()
 
     def unsafe_create_bucket(self, bucket: bytes) -> None:
         self._b._conn.execute(
@@ -57,14 +76,14 @@ class BatchTx:
             f"INSERT OR REPLACE INTO {_table(bucket)} VALUES (?, ?)",
             (key, value))
         self._pending += 1
-        if self._pending > self._b.batch_limit:
+        if self._pending > self._b.batch_limit and not self._hold:
             self._commit()
 
     def unsafe_delete(self, bucket: bytes, key: bytes) -> None:
         self._b._conn.execute(
             f"DELETE FROM {_table(bucket)} WHERE k = ?", (key,))
         self._pending += 1
-        if self._pending > self._b.batch_limit:
+        if self._pending > self._b.batch_limit and not self._hold:
             self._commit()
 
     def unsafe_range(self, bucket: bytes, key: bytes,
@@ -106,6 +125,7 @@ class Backend:
         self.batch_limit = batch_limit
         self.batch_interval = batch_interval
         self.batch_tx = BatchTx(self)
+        self._closed = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="storage-backend")
@@ -122,9 +142,27 @@ class Backend:
     def force_commit(self) -> None:
         self.batch_tx.commit()
 
+    def rollback(self) -> None:
+        """Discard the un-committed batch (everything since the last
+        commit). Used on environmental apply failures: the alternative —
+        letting the timer commit a half-applied transaction after the
+        apply thread died — would make the partial state durable and fork
+        the member from its peers; discarding it is equivalent to a crash
+        at the last commit boundary, which WAL replay covers."""
+        with self.batch_tx.lock:
+            self._conn.rollback()
+            self.batch_tx._pending = 0
+
     def close(self) -> None:
+        """Idempotent: callers (e.g. EtcdServer.stop) may run twice — a
+        restart test stops the old member, then its fixture stops again.
+        The closed connection object is kept so racing users still get
+        sqlite3.ProgrammingError (which the commit/scrub loops catch)."""
         self._stop.set()
         self._thread.join(timeout=5)
         with self.batch_tx.lock:
+            if self._closed:
+                return
+            self._closed = True
             self._conn.commit()
             self._conn.close()

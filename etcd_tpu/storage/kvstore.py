@@ -17,6 +17,7 @@ rebuilds the index by scanning the backend so the store survives restarts.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import struct
@@ -97,6 +98,18 @@ class KVStore:
             tx.unsafe_create_bucket(META_BUCKET)
         self.b.force_commit()
         self.restore()
+
+    @contextlib.contextmanager
+    def atomic(self):
+        """A multi-op atomic unit: holds the store mutex AND the backend
+        batch-tx (commits deferred), so everything inside lands in one
+        sqlite commit and no reader interleaves. Lock order is
+        _mu -> batch_tx — the same order every read/write path uses
+        (txn_begin takes _mu, then the op takes batch_tx) — so this cannot
+        invert against a concurrent serializable reader."""
+        with self._mu:
+            with self.b.batch_tx.hold() as tx:
+                yield tx
 
     # -- single-op API (reference kvstore.go:56-79) -------------------------
 

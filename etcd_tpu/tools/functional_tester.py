@@ -152,9 +152,15 @@ class Cluster:
     cluster.go)."""
 
     def __init__(self, size: int, base_dir: str, heartbeat_ms: int = 20,
-                 election_ms: int = 200, snapshot_count: int = 1000) -> None:
+                 election_ms: int = 200, snapshot_count: int = 1000,
+                 health_timeout: float = 60.0) -> None:
         self.size = size
         self.base_dir = base_dir
+        # Member subprocesses pay a multi-second JAX import on every
+        # (re)start and share CPUs with whatever else runs (a full pytest
+        # session, the reference CI's parallel jobs) — callers under heavy
+        # contention raise this (reference tester budgets minutes/round).
+        self.health_timeout = health_timeout
         ports = _free_ports(2 * size)
         peer_urls = [f"http://127.0.0.1:{ports[i]}" for i in range(size)]
         client_urls = [f"http://127.0.0.1:{ports[size + i]}"
@@ -171,9 +177,10 @@ class Cluster:
             a.start()
         self.wait_health()
 
-    def wait_health(self, timeout: float = 60.0) -> None:
+    def wait_health(self, timeout: Optional[float] = None) -> None:
         """All running members healthy (reference cluster.WaitHealth)."""
-        deadline = time.time() + timeout
+        deadline = time.time() + (timeout if timeout is not None
+                                  else self.health_timeout)
         while time.time() < deadline:
             if all(a.healthy() for a in self.agents if a.running):
                 if any(a.running for a in self.agents):
@@ -371,10 +378,11 @@ class Tester:
 
     def __init__(self, cluster: Cluster,
                  failures: Optional[List[Failure]] = None,
-                 rounds: int = 1) -> None:
+                 rounds: int = 1, progress_timeout: float = 90.0) -> None:
         self.cluster = cluster
         self.failures = failures if failures is not None else FAILURES
         self.rounds = rounds
+        self.progress_timeout = progress_timeout
         self.round = 0
         self.case = 0
         self.succeeded = 0
@@ -424,7 +432,7 @@ class Tester:
             # Generous: member subprocesses share CPUs with the test
             # runner; the reference tester budgets minutes per round
             # (etcd-tester/tester.go round deadlines).
-            deadline = time.time() + 90
+            deadline = time.time() + self.progress_timeout
             while True:
                 try:
                     with urllib.request.urlopen(req, timeout=2.0) as r:

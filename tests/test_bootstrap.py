@@ -20,6 +20,21 @@ def _cfg(tmp, name, peers, cport, **kw):
         tick_ms=10, request_timeout=5.0, **kw)
 
 
+def _retry(fn, timeout=20.0):
+    """Writes during an election window fail (301/timeout) by design —
+    retry like real etcd clients do (reference clients loop on
+    ErrNoLeader; under full-suite load elections take longer)."""
+    import time
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return fn()
+        except Exception:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.3)
+
+
 def test_grow_1_to_3(tmp_path):
     """member-add via the API, then start the new member with
     initial-cluster-state=existing: it takes IDs from the running cluster
@@ -176,7 +191,7 @@ def test_full_member_rotation(tmp_path):
         assert any(m.wait_leader(15) for m in live.values())
         seed_api = KeysAPI(Client([u for m in live.values()
                                    for u in m.client_urls]))
-        seed_api.set("rotation-seed", "survives")
+        _retry(lambda: seed_api.set("rotation-seed", "survives"))
 
         for i in (3, 4, 5):
             old_name = f"m{i - 3}"
@@ -184,7 +199,18 @@ def test_full_member_rotation(tmp_path):
             # 1. propose the new member through a surviving member
             survivor = next(m for n, m in live.items() if n != old_name)
             mapi = MembersAPI(Client(list(survivor.client_urls)))
-            mapi.add([purl[i]])
+
+            def add_member(url=purl[i]):
+                # member-add is NOT idempotent: a timed-out first attempt
+                # may have committed, making every retry fail with
+                # "exists" — which then means success.
+                try:
+                    mapi.add([url])
+                except Exception as ex:
+                    if "exist" not in str(ex).lower():
+                        raise
+
+            _retry(add_member)
             grown = {n: [purl[int(n[1:])]] for n in live}
             grown[new_name] = [purl[i]]
             m = Etcd(_cfg(tmp_path, new_name, grown, ports[6 + i],
@@ -196,8 +222,8 @@ def test_full_member_rotation(tmp_path):
             # 2. wait until the joiner serves the seed, then remove an old
             # member through the API (it self-stops on applying the change).
             k = KeysAPI(Client(list(m.client_urls)))
-            assert k.get("rotation-seed", quorum=True).node.value == \
-                "survives"
+            assert _retry(lambda: k.get("rotation-seed", quorum=True)
+                          ).node.value == "survives"
             victim = live[old_name]
             vid = f"{victim.server.id:x}"
             mapi = MembersAPI(Client(list(m.client_urls)))
@@ -237,7 +263,7 @@ def test_full_member_rotation(tmp_path):
             assert seed.node.value == "survives"
             api.set("post-rotation", "ok")
             break
-        assert api.get("post-rotation").node.value == "ok"
+        assert _retry(lambda: api.get("post-rotation")).node.value == "ok"
     finally:
         for m in live.values():
             m.stop()

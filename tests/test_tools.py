@@ -22,10 +22,13 @@ def test_functional_tester_one_round(tmp_path):
     cluster under stress — every case must inject, recover, and commit new
     writes on every member afterwards."""
     logging.getLogger("functional-tester").setLevel(logging.INFO)
-    c = Cluster(3, str(tmp_path / "cluster"))
+    # Budgets sized for a fully loaded machine: under a whole-suite pytest
+    # run the member subprocesses contend for every core and each restart
+    # pays a multi-second JAX import.
+    c = Cluster(3, str(tmp_path / "cluster"), health_timeout=240.0)
     c.bootstrap()
     cases = [FAILURES[2], FAILURES[1], FAILURES[5]]
-    t = ChaosTester(c, failures=cases, rounds=1)
+    t = ChaosTester(c, failures=cases, rounds=1, progress_timeout=240.0)
     try:
         t.run_loop()
     finally:
