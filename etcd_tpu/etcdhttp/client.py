@@ -370,7 +370,8 @@ class ClientAPI:
                             "etcdcluster": self.server.cluster_version()})
 
     def handle_health(self, ctx: Ctx, suffix: str) -> None:
-        healthy = self.server.leader_id != 0 and not self.server.stopped
+        healthy = (self.server.leader_id != 0 and not self.server.stopped
+                   and not getattr(self.server, "_fatal", False))
         ctx.send_json(200 if healthy else 503,
                       {"health": "true" if healthy else "false"})
 

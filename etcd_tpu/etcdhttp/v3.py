@@ -38,6 +38,12 @@ class V3API:
         if ctx.method != "POST":
             ctx.send(405, b"Method Not Allowed", headers={"Allow": "POST"})
             return
+        if getattr(self.server, "_fatal", False):
+            # Serializable reads bypass do(); refuse them too — the
+            # in-memory index may have forked from the rolled-back backend.
+            self._err(ctx, 500, 13,
+                      "member failed (fatal apply error); restart required")
+            return
         # v2 auth has no v3 user model, so when security is enabled the
         # whole v3 preview surface requires root credentials — the same
         # listener must not offer an unauthenticated write path (the

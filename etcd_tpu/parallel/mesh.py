@@ -15,6 +15,12 @@ The domain's parallelism axes (SURVEY.md §2.2) map onto a 2-D device mesh:
 In a real multi-host deployment each host is a failure domain holding one
 peer slot of every group (peers axis sharded across hosts over DCN); on a
 single pod/chip both axes are just throughput axes.
+
+The multi-host shape is executable TODAY without TPU pods:
+scripts/multihost_dryrun.py boots N OS processes into one global mesh via
+jax.distributed (gloo CPU collectives) with the peers axis crossing
+process boundaries, and runs elections + commits through cross-process
+routing collectives (tests/test_multihost.py keeps it green).
 """
 from __future__ import annotations
 

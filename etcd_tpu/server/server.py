@@ -126,6 +126,11 @@ class EtcdServer:
         self._thread: Optional[threading.Thread] = None
         self._published = False
         self._removed_self = False
+        # Set when an environmental apply failure killed the run loop: the
+        # member must refuse all service (reads could see forked in-memory
+        # state) until restarted — the process-level analogue of the
+        # reference's panic-on-backend-error.
+        self._fatal = False
         self._sync_elapsed = 0
         self.lead_elected_ev = threading.Event()
         self._force_version_ev = threading.Event()  # reference forceVersionC
@@ -359,6 +364,10 @@ class EtcdServer:
     def do(self, r: Request) -> Any:
         """Serve one request (reference Do server.go:519-576): local reads
         from the store; writes (and quorum reads) through consensus."""
+        if self._fatal:
+            raise errors.EtcdError(
+                errors.ECODE_RAFT_INTERNAL,
+                cause="member failed (fatal apply error); restart required")
         if r.method == METHOD_GET:
             if r.quorum:
                 r = raftpb.replace(r, method=METHOD_QGET)
@@ -366,10 +375,9 @@ class EtcdServer:
                 return self.store.watch(r.path, r.recursive, r.stream, r.since)
             else:
                 return self.store.get(r.path, r.recursive, r.sorted)
-        if r.method == METHOD_V3 and r.v3 and r.v3.get("type") == "range" \
-                and not r.v3.get("linearizable"):
-            # Serializable v3 read: straight off the local kvstore.
-            return self.v3.range(r.v3)
+        # (Serializable v3 ranges never reach do(): the gateway reads the
+        # local kvstore directly; linearizable ones ride the log below and
+        # V3Applier.apply serves them without a consistent-index write.)
         if r.method in (METHOD_PUT, METHOD_POST, METHOD_DELETE, METHOD_QGET,
                         METHOD_SYNC, METHOD_V3):
             if r.id == 0:
@@ -711,7 +719,8 @@ class EtcdServer:
                 # Deterministic data errors can't land here: validate_op
                 # turns them into V3Errors on every member identically.
                 log.exception("fatal: v3 apply failed at index %d; "
-                              "stopping applies on this member", index)
+                              "member refuses service until restart", index)
+                self._fatal = True
                 raise
         st = self.store
         exp = r.expiration

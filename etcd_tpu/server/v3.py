@@ -150,15 +150,24 @@ class V3Applier:
         rev = int(op.get("revision", 0))
         try:
             kvs, cur = self.kv.range(key, end, limit=limit, range_rev=rev)
+            # `count` is the TOTAL matching the range (ignoring limit) and
+            # `more` only true when keys were actually truncated (etcd
+            # gateway semantics) — hitting the limit exactly is not
+            # "more". Only the boundary case pays the second (unlimited)
+            # read.
+            total = len(kvs)
+            if limit and len(kvs) == limit:
+                all_kvs, _ = self.kv.range(key, end, limit=0,
+                                           range_rev=rev or cur)
+                total = len(all_kvs)
         except CompactedError as e:
             raise V3Error(11, f"required revision {e.args[0]} has been "
                               "compacted")
-        more = bool(limit) and len(kvs) == limit
         return {
             "header": {"revision": cur},
             "kvs": [self._kv_json(kv) for kv in kvs],
-            "count": len(kvs),
-            "more": more,
+            "count": total,
+            "more": total > len(kvs),
         }
 
     @staticmethod
@@ -225,8 +234,6 @@ class V3Applier:
             end = b64d(op["range_end"]) if op.get("range_end") else None
             n, rev = self.kv.delete_range(b64d(op["key"]), end)
             return {"header": {"revision": rev}, "deleted": n}
-        if t == "range":   # linearizable read: rides the apply stream
-            return self.range(op)
         if t == "compact":
             rev = int(op.get("revision", 0))
             try:
@@ -250,12 +257,21 @@ class V3Applier:
         # txn_begin (validate_op covers structure; a compacted range
         # revision is the remaining data-dependent case) — a mid-txn error
         # would commit a partial txn, and etcd txns are all-or-nothing.
+        # The rr==0 case resolves to the CURRENT revision, which is itself
+        # compacted when the store was compacted at head and no mutation
+        # precedes the range in this txn (a mutation bumps the read
+        # revision past the boundary).
+        head_compacted = self.kv.compact_main_rev >= self.kv.current_rev.main
+        mutated = False
         for r in reqs:
-            if "request_range" in r:
+            if "request_put" in r or "request_delete_range" in r:
+                mutated = True
+            elif "request_range" in r:
                 rr = int(r["request_range"].get("revision", 0))
-                if 0 < rr <= self.kv.compact_main_rev:
-                    raise V3Error(11, f"required revision {rr} has been "
-                                      "compacted")
+                if (0 < rr <= self.kv.compact_main_rev) or (
+                        rr == 0 and head_compacted and not mutated):
+                    raise V3Error(11, f"required revision has been "
+                                      f"compacted (at {rr or 'head'})")
         tid = self.kv.txn_begin()
         responses = []
         try:
@@ -299,7 +315,13 @@ class V3Applier:
         result = c.get("result", "EQUAL")
         if target not in _TARGETS or result not in _RESULTS:
             raise V3Error(3, f"bad compare {c!r}")
-        kvs, _ = self.kv.range(b64d(c["key"]))
+        try:
+            kvs, _ = self.kv.range(b64d(c["key"]))
+        except CompactedError:
+            # Head-compacted store: the compare itself reads at a
+            # compacted revision. Deterministic -> a V3Error, never an
+            # apply-thread fatal.
+            raise V3Error(11, "required revision has been compacted")
         if target == "VALUE":
             have: Any = kvs[0].value if kvs else b""
             want: Any = b64d(c.get("value", ""))

@@ -1,0 +1,29 @@
+"""Multi-PROCESS SPMD execution of the consensus kernel (the DCN
+transport class, SURVEY §2.4): two OS processes form one global mesh with
+the peers axis crossing the process boundary, so the per-round message
+routing is a cross-process collective — the multi-host shape of the real
+deployment, minus the physical DCN.
+
+Runs the same script the driver can run standalone
+(scripts/multihost_dryrun.py); subprocess-based, so it lives in the slow
+tier with the chaos harness.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+SCRIPT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "multihost_dryrun.py")
+
+
+@pytest.mark.slow
+def test_two_process_mesh_elections_and_commits():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run([sys.executable, SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "all 2 ranks OK" in out.stdout

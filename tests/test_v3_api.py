@@ -106,7 +106,7 @@ def test_range_prefix_and_limit(cluster):
     assert b["count"] == 5
     st, _, b = v3(cluster, "range",
                   {"key": e("pfx/"), "range_end": e("pfx0"), "limit": 2})
-    assert b["count"] == 2 and b["more"] is True
+    assert b["count"] == 5 and b["more"] is True and len(b["kvs"]) == 2
 
 
 def test_delete_range(cluster):
@@ -182,6 +182,54 @@ def test_compact_and_compacted_error(cluster):
     # Current read still fine.
     st, _, b = v3(cluster, "range", {"key": e("cp")})
     assert d(b["kvs"][0]["value"]) == "2"
+
+
+def test_compact_at_head_then_txn_is_an_error_not_a_crash(cluster):
+    """The killer sequence: compact at the CURRENT revision, then send a
+    txn whose compare reads at head — the read resolves to a compacted
+    revision. Must be a deterministic error response; an escaped
+    CompactedError would kill the apply thread on every member."""
+    st, _, b = v3(cluster, "put", {"key": e("headc"), "value": e("1")})
+    rev = b["header"]["revision"]
+    st, _, b = v3(cluster, "compact", {"revision": rev})
+    assert st == 200
+    st, _, b = v3(cluster, "txn", {
+        "compare": [{"key": e("headc"), "target": "VALUE",
+                     "result": "EQUAL", "value": e("1")}],
+        "success": [{"request_put": {"key": e("headc"), "value": e("2")}}],
+        "failure": []})
+    assert st == 400 and b["code"] == 11, (st, b)
+    # rr==0 range before any mutation in the txn: same boundary.
+    st, _, b = v3(cluster, "txn", {
+        "compare": [],
+        "success": [{"request_range": {"key": e("headc")}}],
+        "failure": []})
+    assert st == 400 and b["code"] == 11, (st, b)
+    # A mutation-first txn moves the read revision past the boundary.
+    st, _, b = v3(cluster, "txn", {
+        "compare": [],
+        "success": [{"request_put": {"key": e("headc"), "value": e("2")}},
+                    {"request_range": {"key": e("headc")}}],
+        "failure": []})
+    assert st == 200 and b["succeeded"] is True, (st, b)
+    # Every member still serves (apply threads alive).
+    for m in range(3):
+        st, _, b = v3(cluster, "put",
+                      {"key": e(f"headalive{m}"), "value": e("1")},
+                      member=m)
+        assert st == 200, f"member {m} apply thread dead"
+
+
+def test_range_count_and_more_are_etcd_semantics(cluster):
+    """`count` is the total ignoring limit; `more` only when truncated."""
+    for i in range(4):
+        v3(cluster, "put", {"key": e(f"cnt/{i}"), "value": e("x")})
+    st, _, b = v3(cluster, "range",
+                  {"key": e("cnt/"), "range_end": e("cnt0"), "limit": 4})
+    assert b["count"] == 4 and b["more"] is False and len(b["kvs"]) == 4
+    st, _, b = v3(cluster, "range",
+                  {"key": e("cnt/"), "range_end": e("cnt0"), "limit": 2})
+    assert b["count"] == 4 and b["more"] is True and len(b["kvs"]) == 2
 
 
 def test_unimplemented_watch_and_lease(cluster):
