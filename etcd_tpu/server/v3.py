@@ -152,14 +152,10 @@ class V3Applier:
             kvs, cur = self.kv.range(key, end, limit=limit, range_rev=rev)
             # `count` is the TOTAL matching the range (ignoring limit) and
             # `more` only true when keys were actually truncated (etcd
-            # gateway semantics) — hitting the limit exactly is not
-            # "more". Only the boundary case pays the second (unlimited)
-            # read.
-            total = len(kvs)
-            if limit and len(kvs) == limit:
-                all_kvs, _ = self.kv.range(key, end, limit=0,
-                                           range_rev=rev or cur)
-                total = len(all_kvs)
+            # gateway semantics). The total comes from the in-memory index
+            # (no backend value reads), and only when the limit bound.
+            total = (self.kv.count(key, end, range_rev=cur)
+                     if limit and len(kvs) == limit else len(kvs))
         except CompactedError as e:
             raise V3Error(11, f"required revision {e.args[0]} has been "
                               "compacted")
@@ -253,25 +249,21 @@ class V3Applier:
         succeeded = all(self._check(c) for c in op.get("compare", []))
         reqs: List[Dict[str, Any]] = op.get(
             "success" if succeeded else "failure", [])
-        # Atomicity: every error a request can raise must be raised BEFORE
-        # txn_begin (validate_op covers structure; a compacted range
-        # revision is the remaining data-dependent case) — a mid-txn error
-        # would commit a partial txn, and etcd txns are all-or-nothing.
-        # The rr==0 case resolves to the CURRENT revision, which is itself
-        # compacted when the store was compacted at head and no mutation
-        # precedes the range in this txn (a mutation bumps the read
-        # revision past the boundary).
-        head_compacted = self.kv.compact_main_rev >= self.kv.current_rev.main
-        mutated = False
+        # Atomicity: errors must not abort a txn after it mutated (etcd
+        # txns are all-or-nothing). validate_op covers structure pre-txn;
+        # EXPLICIT compacted range revisions are checked here because they
+        # can fail even after a mutation ran. The remaining case — a
+        # head-revision (rr==0) range on a head-compacted store — is safe
+        # to catch mid-loop: it can only fire while sub==0, i.e. before
+        # ANY mutation executed (a mutation bumps sub, which pushes the
+        # resolved read revision past the compaction boundary), so
+        # aborting there is atomic and deterministic.
         for r in reqs:
-            if "request_put" in r or "request_delete_range" in r:
-                mutated = True
-            elif "request_range" in r:
+            if "request_range" in r:
                 rr = int(r["request_range"].get("revision", 0))
-                if (0 < rr <= self.kv.compact_main_rev) or (
-                        rr == 0 and head_compacted and not mutated):
-                    raise V3Error(11, f"required revision has been "
-                                      f"compacted (at {rr or 'head'})")
+                if 0 < rr <= self.kv.compact_main_rev:
+                    raise V3Error(11, f"required revision {rr} has been "
+                                      "compacted")
         tid = self.kv.txn_begin()
         responses = []
         try:
@@ -295,14 +287,25 @@ class V3Applier:
                     p = r["request_range"]
                     end = (b64d(p["range_end"])
                            if p.get("range_end") else None)
-                    kvs, cur = self.kv.txn_range(
-                        tid, b64d(p["key"]), end,
-                        limit=int(p.get("limit", 0)),
-                        range_rev=int(p.get("revision", 0)))
+                    lim = int(p.get("limit", 0))
+                    try:
+                        kvs, cur = self.kv.txn_range(
+                            tid, b64d(p["key"]), end, limit=lim,
+                            range_rev=int(p.get("revision", 0)))
+                        total = (self.kv.count(b64d(p["key"]), end,
+                                               range_rev=cur)
+                                 if lim and len(kvs) == lim else len(kvs))
+                    except CompactedError:
+                        # Head-compacted store: only reachable with sub==0
+                        # (nothing mutated yet — see precheck comment), so
+                        # this abort is atomic.
+                        raise V3Error(11, "required revision has been "
+                                          "compacted")
                     responses.append({"response_range": {
                         "header": {"revision": cur},
                         "kvs": [self._kv_json(kv) for kv in kvs],
-                        "count": len(kvs)}})
+                        "count": total,
+                        "more": total > len(kvs)}})
                 else:
                     raise V3Error(3, f"unknown txn request {r!r}")
         finally:

@@ -129,6 +129,24 @@ class KVStore:
         finally:
             self.txn_end(tid)
 
+    def count(self, key: bytes, end: Optional[bytes] = None,
+              range_rev: int = 0) -> int:
+        """Number of live keys in [key, end) at the revision — answered
+        entirely from the in-memory index (the index never surfaces
+        tombstoned generations), so counting a huge range costs no backend
+        reads or value decodes."""
+        with self._mu:
+            if range_rev <= 0:
+                rev = self.current_rev.main
+                if self.current_rev.sub > 0:
+                    rev += 1
+            else:
+                rev = range_rev
+            if rev <= self.compact_main_rev:
+                raise CompactedError(rev)
+            _, revpairs = self.kvindex.range(key, end, rev)
+            return len(revpairs)
+
     def delete_range(self, key: bytes, end: Optional[bytes] = None
                      ) -> Tuple[int, int]:
         tid = self.txn_begin()

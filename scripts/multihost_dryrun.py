@@ -112,7 +112,18 @@ def spawn_all() -> int:
         env.pop("XLA_FLAGS", None)   # ranks set their own device count
         procs.append(subprocess.Popen([sys.executable,
                                        os.path.abspath(__file__)], env=env))
-    rcs = [p.wait(timeout=600) for p in procs]
+    # Shorter than any caller's kill timeout (tests/test_multihost.py uses
+    # 560s): on a hung gloo collective, the spawner must kill BOTH ranks
+    # itself — dying first would orphan them on the coordinator port.
+    try:
+        rcs = [p.wait(timeout=420) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()
+        print("FAILED: ranks hung; killed", file=sys.stderr)
+        return 1
     if any(rcs):
         print(f"FAILED: ranks exited {rcs}", file=sys.stderr)
         return 1

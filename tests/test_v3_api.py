@@ -205,6 +205,15 @@ def test_compact_at_head_then_txn_is_an_error_not_a_crash(cluster):
         "success": [{"request_range": {"key": e("headc")}}],
         "failure": []})
     assert st == 400 and b["code"] == 11, (st, b)
+    # A NO-OP delete (no matching key) is not a mutation: the following
+    # head-revision range still resolves compacted — error, not a crash,
+    # and nothing applied.
+    st, _, b = v3(cluster, "txn", {
+        "compare": [],
+        "success": [{"request_delete_range": {"key": e("no/such/key")}},
+                    {"request_range": {"key": e("headc")}}],
+        "failure": []})
+    assert st == 400 and b["code"] == 11, (st, b)
     # A mutation-first txn moves the read revision past the boundary.
     st, _, b = v3(cluster, "txn", {
         "compare": [],
@@ -230,6 +239,15 @@ def test_range_count_and_more_are_etcd_semantics(cluster):
     st, _, b = v3(cluster, "range",
                   {"key": e("cnt/"), "range_end": e("cnt0"), "limit": 2})
     assert b["count"] == 4 and b["more"] is True and len(b["kvs"]) == 2
+    # Same semantics inside a txn's response_range.
+    st, _, b = v3(cluster, "txn", {
+        "compare": [],
+        "success": [{"request_range": {"key": e("cnt/"),
+                                       "range_end": e("cnt0"),
+                                       "limit": 2}}],
+        "failure": []})
+    rr = b["responses"][0]["response_range"]
+    assert rr["count"] == 4 and rr["more"] is True and len(rr["kvs"]) == 2
 
 
 def test_unimplemented_watch_and_lease(cluster):
