@@ -420,8 +420,14 @@ def child_main() -> int:
                     break
             elapsed = time.time() - t0
             acked = int(eng.applied.sum()) - a0
+            # Drain: a few empty rounds ack the final sampled waiters so
+            # the collector reaches the sentinel, and the join completes
+            # BEFORE percentiles read lat_samples (no concurrent appends,
+            # no silently dropped tail samples).
+            for _ in range(6):
+                eng.run_round()
             collector_q.put(None)
-            col.join(timeout=5)
+            col.join(timeout=60)
             eng.stop()
         aps = acked / elapsed
         p50 = (round(1000 * float(np.percentile(lat_samples, 50)), 3)

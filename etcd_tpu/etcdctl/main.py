@@ -5,6 +5,9 @@ ls/mk/mkdir/rm/rmdir/get/set/setdir/update/updatedir/watch/exec-watch,
 member list|add|remove, cluster-health, backup (disaster-recovery WAL copy
 with fresh node identity, backup_command.go:33-) and import. Peers come
 from --peers / ETCDCTL_PEERS; output shapes follow the reference commands.
+
+Beyond the reference: `v3 put|get|del|compact|txn` drive the served v3 KV
+preview (/v3/kv gateway; the reference ships only the RFC).
 """
 from __future__ import annotations
 
@@ -39,6 +42,118 @@ def _keys(args) -> KeysAPI:
 def _die(msg: str, code: int = 1) -> int:
     print(f"Error: {msg}", file=sys.stderr)
     return code
+
+
+# -- v3 commands (the served v3 preview; reference ships only the RFC) -------
+
+def _v3_call(args, path: str, body: dict):
+    """POST one v3 op to the first answering endpoint (JSON gateway)."""
+    import base64 as _b64
+    import urllib.error
+    import urllib.request
+
+    peers = (args.peers or os.environ.get("ETCDCTL_PEERS") or
+             DEFAULT_PEERS).split(",")
+    headers = {"Content-Type": "application/json"}
+    if args.username:
+        headers["Authorization"] = "Basic " + _b64.b64encode(
+            args.username.encode()).decode()
+    err = None
+    for ep in (p.strip() for p in peers if p.strip()):
+        req = urllib.request.Request(f"{ep}/v3/kv/{path}",
+                                     data=json.dumps(body).encode(),
+                                     method="POST", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=args.timeout) as r:
+                return r.status, json.loads(r.read() or b"null")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null")
+        except OSError as e:
+            err = e
+    raise ClientError(f"no endpoint reachable: {err}")
+
+
+def _b64s(s: str) -> str:
+    import base64 as _b64
+    return _b64.b64encode(s.encode()).decode()
+
+
+def _b64d(s: str) -> str:
+    import base64 as _b64
+    return _b64.b64decode(s).decode(errors="replace")
+
+
+def _prefix_end_b64(key: str) -> str:
+    """base64 of the smallest byte string greater than every key with this
+    prefix. Computed on RAW bytes and base64'd directly — a bytes->str
+    round-trip would mangle the (often invalid-UTF-8) end bytes and make
+    --prefix match/delete keys OUTSIDE the prefix."""
+    import base64 as _b64
+    b = bytearray(key.encode())
+    for i in range(len(b) - 1, -1, -1):
+        if b[i] < 0xFF:
+            b[i] += 1
+            return _b64.b64encode(bytes(b[:i + 1])).decode()
+    return _b64.b64encode(b"\x00").decode()   # whole keyspace
+
+
+def cmd_v3_put(args) -> int:
+    st, b = _v3_call(args, "put", {"key": _b64s(args.key),
+                                   "value": _b64s(args.value)})
+    if st != 200:
+        return _die(b.get("error", str(b)))
+    print("OK")
+    return 0
+
+
+def cmd_v3_get(args) -> int:
+    body = {"key": _b64s(args.key)}
+    if args.prefix:
+        body["range_end"] = _prefix_end_b64(args.key)
+    if args.rev:
+        body["revision"] = args.rev
+    if args.limit:
+        body["limit"] = args.limit
+    if args.serializable:
+        body["serializable"] = True
+    st, b = _v3_call(args, "range", body)
+    if st != 200:
+        return _die(b.get("error", str(b)))
+    for kv in b.get("kvs", []):
+        print(_b64d(kv["key"]))
+        print(_b64d(kv["value"]))
+    return 0
+
+
+def cmd_v3_del(args) -> int:
+    body = {"key": _b64s(args.key)}
+    if args.prefix:
+        body["range_end"] = _prefix_end_b64(args.key)
+    st, b = _v3_call(args, "deleterange", body)
+    if st != 200:
+        return _die(b.get("error", str(b)))
+    print(b.get("deleted", 0))
+    return 0
+
+
+def cmd_v3_compact(args) -> int:
+    st, b = _v3_call(args, "compact", {"revision": args.revision})
+    if st != 200:
+        return _die(b.get("error", str(b)))
+    print(f"compacted revision {args.revision}")
+    return 0
+
+
+def cmd_v3_txn(args) -> int:
+    """Reads a TxnRequest as JSON from stdin (compare/success/failure with
+    base64 bytes fields, the gateway encoding) and prints the response."""
+    try:
+        body = json.loads(sys.stdin.read() or "{}")
+    except json.JSONDecodeError as e:
+        return _die(f"bad txn JSON on stdin: {e}")
+    st, b = _v3_call(args, "txn", body)
+    print(json.dumps(b, indent=2))
+    return 0 if st == 200 else 1
 
 
 # -- key commands (reference etcdctl/command/*_command.go) -------------------
@@ -395,6 +510,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_member_remove)
 
     add("cluster-health", cmd_cluster_health)
+
+    pv3 = sub.add_parser("v3", help="v3 KV preview (served /v3/kv gateway)")
+    v3sub = pv3.add_subparsers(dest="v3_command", required=True)
+    p = v3sub.add_parser("put")
+    p.add_argument("key")
+    p.add_argument("value")
+    p.set_defaults(fn=cmd_v3_put)
+    p = v3sub.add_parser("get")
+    p.add_argument("key")
+    p.add_argument("--prefix", action="store_true")
+    p.add_argument("--rev", type=int, default=0)
+    p.add_argument("--limit", type=int, default=0)
+    p.add_argument("--serializable", action="store_true")
+    p.set_defaults(fn=cmd_v3_get)
+    p = v3sub.add_parser("del")
+    p.add_argument("key")
+    p.add_argument("--prefix", action="store_true")
+    p.set_defaults(fn=cmd_v3_del)
+    p = v3sub.add_parser("compact")
+    p.add_argument("revision", type=int)
+    p.set_defaults(fn=cmd_v3_compact)
+    p = v3sub.add_parser("txn", help="TxnRequest JSON on stdin")
+    p.set_defaults(fn=cmd_v3_txn)
 
     p = add("backup", cmd_backup)
     p.add_argument("--data-dir", required=True)

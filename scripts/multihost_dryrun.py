@@ -112,11 +112,15 @@ def spawn_all() -> int:
         env.pop("XLA_FLAGS", None)   # ranks set their own device count
         procs.append(subprocess.Popen([sys.executable,
                                        os.path.abspath(__file__)], env=env))
-    # Shorter than any caller's kill timeout (tests/test_multihost.py uses
-    # 560s): on a hung gloo collective, the spawner must kill BOTH ranks
-    # itself — dying first would orphan them on the coordinator port.
+    # ONE shared deadline, shorter than any caller's kill timeout
+    # (tests/test_multihost.py uses 560s): on a hung gloo collective the
+    # spawner must kill BOTH ranks itself — dying first would orphan them
+    # on the coordinator port. (Per-process timeouts would stack.)
+    import time
+    deadline = time.time() + 420
     try:
-        rcs = [p.wait(timeout=420) for p in procs]
+        rcs = [p.wait(timeout=max(1.0, deadline - time.time()))
+               for p in procs]
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()

@@ -114,3 +114,46 @@ def test_backup(ctl, member, tmp_path, capsys):
     md = json.loads(metadata.decode())
     assert md["id"] == "0" and md["clusterId"] == "0"
     assert hs.commit > 0
+
+
+def test_v3_put_get_del(ctl):
+    assert ctl("v3", "put", "vk", "vval") == "OK\n"
+    assert ctl("v3", "get", "vk") == "vk\nvval\n"
+    ctl("v3", "put", "vk2", "x")
+    out = ctl("v3", "get", "vk", "--prefix")
+    assert "vk" in out and "vk2" in out and "vval" in out
+    assert ctl("v3", "del", "vk2") == "1\n"
+    assert ctl("v3", "get", "vk2") == ""
+    out = ctl("v3", "get", "vk", "--serializable")
+    assert "vval" in out
+
+
+def test_v3_historical_rev_read(ctl, member):
+    ctl("v3", "put", "revk", "old")
+    rev = member.server.v3.kv.current_rev.main
+    ctl("v3", "put", "revk", "new")
+    assert ctl("v3", "get", "revk") == "revk\nnew\n"
+    assert ctl("v3", "get", "revk", "--rev", str(rev)) == "revk\nold\n"
+
+
+def test_v3_txn_and_compact(ctl, monkeypatch):
+    import io
+    import sys as _sys
+
+    ctl("v3", "put", "txnk", "old")
+    txn = {
+        "compare": [{"key": _b64("txnk"), "target": "VALUE",
+                     "result": "EQUAL", "value": _b64("old")}],
+        "success": [{"request_put": {"key": _b64("txnk"),
+                                     "value": _b64("new")}}],
+        "failure": [],
+    }
+    monkeypatch.setattr(_sys, "stdin", io.StringIO(json.dumps(txn)))
+    out = ctl("v3", "txn")
+    assert '"succeeded": true' in out
+    assert ctl("v3", "get", "txnk") == "txnk\nnew\n"
+
+
+def _b64(s):
+    import base64
+    return base64.b64encode(s.encode()).decode()
