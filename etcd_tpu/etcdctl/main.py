@@ -47,30 +47,22 @@ def _die(msg: str, code: int = 1) -> int:
 # -- v3 commands (the served v3 preview; reference ships only the RFC) -------
 
 def _v3_call(args, path: str, body: dict):
-    """POST one v3 op to the first answering endpoint (JSON gateway)."""
-    import base64 as _b64
-    import urllib.error
-    import urllib.request
-
-    peers = (args.peers or os.environ.get("ETCDCTL_PEERS") or
-             DEFAULT_PEERS).split(",")
-    headers = {"Content-Type": "application/json"}
-    if args.username:
-        headers["Authorization"] = "Basic " + _b64.b64encode(
-            args.username.encode()).decode()
-    err = None
-    for ep in (p.strip() for p in peers if p.strip()):
-        req = urllib.request.Request(f"{ep}/v3/kv/{path}",
-                                     data=json.dumps(body).encode(),
-                                     method="POST", headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=args.timeout) as r:
-                return r.status, json.loads(r.read() or b"null")
-        except urllib.error.HTTPError as e:
-            return e.code, json.loads(e.read() or b"null")
-        except OSError as e:
-            err = e
-    raise ClientError(f"no endpoint reachable: {err}")
+    """POST one v3 op through the shared Client (endpoint failover, 5xx
+    rotation and Basic auth all come from Client.do — one code path with
+    the v2 commands)."""
+    resp = _client(args).do("POST", f"/v3/kv/{path}",
+                            json.dumps(body).encode(),
+                            headers={"Content-Type": "application/json"})
+    try:
+        parsed = json.loads(resp.body) if resp.body else None
+    except json.JSONDecodeError:
+        parsed = None
+    if not isinstance(parsed, dict):
+        # Non-gateway answer (v2-only member, proxy error page): a clean
+        # CLI error, not a traceback.
+        parsed = {"error": (resp.body or b"").decode(errors="replace")
+                  [:200] or f"HTTP {resp.status}", "code": 13}
+    return resp.status, parsed
 
 
 def _b64s(s: str) -> str:

@@ -7,8 +7,12 @@ etcd JSON-gateway way: POST with a JSON body, bytes fields base64).
     POST /v3/kv/deleterange  DeleteRangeRequest -> DeleteRangeResponse
     POST /v3/kv/txn          TxnRequest     -> TxnResponse
     POST /v3/kv/compact      CompactionRequest -> CompactionResponse
-    POST /v3/watch, /v3/lease/*   501 (declared by the RFC, implementation
-                                  pending — the reference implements neither)
+    POST /v3/watch           WatchRange     -> chunked stream of
+                             {"result": {header, events}} JSON lines
+                             (created confirmation first; start_revision
+                             replays history)
+    POST /v3/lease/*         501 (declared by the RFC, implementation
+                             pending — the reference implements neither)
 
 Mutations (and linearizable ranges) ride the member's consensus log as
 METHOD_V3 requests; serializable ranges (`"serializable": true`) read the
@@ -61,16 +65,18 @@ class V3API:
         except (ValueError, json.JSONDecodeError) as e:
             self._err(ctx, 400, 3, f"bad request body: {e}")
             return
+        if suffix == "watch":
+            self._handle_watch(ctx, body)
+            return
         route = {
             "kv/range": "range", "kv/put": "put",
             "kv/deleterange": "deleterange", "kv/txn": "txn",
             "kv/compact": "compact",
         }.get(suffix)
         if route is None:
-            if suffix == "watch" or suffix.startswith("lease"):
-                self._err(ctx, 501, 12,
-                          f"v3 {suffix.split('/')[0]} is declared by the "
-                          "RFC but not yet implemented")
+            if suffix.startswith("lease"):
+                self._err(ctx, 501, 12, "v3 lease is declared by the RFC "
+                                        "but not yet implemented")
             else:
                 self._err(ctx, 404, 3, f"unknown v3 path {suffix!r}")
             return
@@ -99,6 +105,62 @@ class V3API:
             self._v3err(ctx, result)
             return
         ctx.send_json(200, result)
+
+    def _handle_watch(self, ctx: Ctx, body: dict) -> None:
+        """Streamed WatchRange (RFC v3api.proto WatchRange rpc): a chunked
+        response of JSON lines — first a created confirmation, then one
+        {"result": {header, events}} line per committed revision touching
+        the range. start_revision replays history first (compacted ->
+        error), exactly like etcd's watch."""
+        import base64
+        from etcd_tpu.server.v3 import V3Error as _V3E
+        from etcd_tpu.server.v3 import validate_op
+
+        try:
+            validate_op({**{k: body.get(k) for k in
+                            ("key", "range_end", "limit")},
+                         "type": "range",
+                         "revision": body.get("start_revision")})
+            key = base64.b64decode(body["key"])
+            end = (base64.b64decode(body["range_end"])
+                   if body.get("range_end") else None)
+            start = int(body.get("start_revision") or 0)
+            w = self.server.v3.watch(key, end, start)
+        except _V3E as e:
+            self._v3err(ctx, e)
+            return
+        try:
+            ctx.begin_stream(200, "application/json")
+            created = {"result": {
+                "header": {"revision": self.server.v3.kv.current_rev.main},
+                "created": True}}
+            if not ctx.write_chunk(json.dumps(created).encode() + b"\n"):
+                return
+            while True:
+                batch = w.next_batch(timeout=0.5)
+                if batch is not None:
+                    rev, events = batch
+                    line = json.dumps({"result": {
+                        "header": {"revision": rev},
+                        "events": events}}).encode() + b"\n"
+                    if not ctx.write_chunk(line):
+                        return
+                elif w.cancelled:
+                    # Slow consumer: the hub dropped this watcher rather
+                    # than buffer without bound (etcd cancels, clients
+                    # re-watch from their last seen revision).
+                    ctx.write_chunk(json.dumps(
+                        {"result": {"canceled": True,
+                                    "reason": "watcher queue overflow"}}
+                    ).encode() + b"\n")
+                    ctx.end_stream()
+                    return
+                elif ctx.client_gone() or self.server.stopped or \
+                        getattr(self.server, "_fatal", False):
+                    ctx.end_stream()
+                    return
+        finally:
+            w.remove()
 
     def _v3err(self, ctx: Ctx, e: V3Error) -> None:
         # grpc code 11 = OutOfRange (compacted), 3 = InvalidArgument.

@@ -83,6 +83,15 @@ class Ctx:
     def begin_stream(self, status: int, content_type: str,
                      headers: Optional[Dict[str, str]] = None) -> None:
         h = self._h
+        # A stream writer must never block forever on a stalled client:
+        # with no socket timeout, a peer that stops reading (TCP buffers
+        # full) would pin this handler thread inside wfile.write and its
+        # watcher would never be released. timeout -> OSError subclass ->
+        # write_chunk returns False -> the loop cleans up.
+        try:
+            h.connection.settimeout(30.0)
+        except OSError:
+            pass
         h.send_response(status)
         h.send_header("Content-Type", content_type)
         h.send_header("Transfer-Encoding", "chunked")

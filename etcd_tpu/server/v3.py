@@ -127,19 +127,143 @@ def _need_list(op: Dict[str, Any], field: str) -> List[Any]:
     return v
 
 
+class V3Watcher:
+    """One watch stream over [key, range_end) from a start revision.
+    Events arrive as (revision, [event_dict]) batches in revision order.
+    A watcher whose consumer stalls past the queue bound is CANCELLED
+    (etcd closes slow watchers rather than buffer without bound)."""
+
+    QUEUE_BOUND = 1024
+
+    def __init__(self, hub: "V3Applier", key: bytes,
+                 end: Optional[bytes]) -> None:
+        import queue as _q
+        self._hub = hub
+        self.key = key
+        self.end = end
+        self.q: "_q.Queue" = _q.Queue(maxsize=self.QUEUE_BOUND)
+        self.cancelled = False
+
+    def matches(self, k: bytes) -> bool:
+        if self.end is None:
+            return k == self.key
+        if self.end == b"\x00":   # etcd whole-keyspace sentinel
+            return k >= self.key
+        return self.key <= k < self.end
+
+    def next_batch(self, timeout: float = 0.5):
+        import queue as _q
+        try:
+            return self.q.get(timeout=timeout)
+        except _q.Empty:
+            return None
+
+    def remove(self) -> None:
+        self._hub._remove_watcher(self)
+
+
 class V3Applier:
     """Deterministic v3 op application over one member's KVStore."""
 
     def __init__(self, path: str) -> None:
+        import threading
         self.kv = KVStore(path)
         self.consistent_index = 0
         with self.kv.b.batch_tx as tx:
             _, vs = tx.unsafe_range(META_BUCKET, CONSISTENT_INDEX_KEY)
         if vs:
             self.consistent_index = struct.unpack(">Q", vs[0])[0]
+        # Watch hub (the RFC's WatchRange): _published_rev is the fence
+        # between historical replay (read from the backend) and live
+        # publishes — a watcher registering mid-apply must not see the
+        # in-flight revision twice or miss it.
+        self._watch_lock = threading.Lock()
+        self._watchers: List[V3Watcher] = []
+        self._published_rev = self.kv.current_rev.main
 
     def close(self) -> None:
         self.kv.close()
+
+    # -- watch (RFC WatchRange) --------------------------------------------
+
+    def watch(self, key: bytes, end: Optional[bytes],
+              start_rev: int = 0) -> V3Watcher:
+        """Register a watcher; start_rev > 0 first replays the historical
+        events in (start_rev-1, now] from the backend (compacted start
+        revisions error, like range)."""
+        w = V3Watcher(self, key, end)
+        with self._watch_lock:
+            if start_rev > 0:
+                if start_rev <= self.kv.compact_main_rev:
+                    raise V3Error(11, f"required revision {start_rev} has "
+                                      "been compacted")
+                for rev, evs in self._events_between(start_rev - 1,
+                                                     self._published_rev):
+                    mine = [e for e in evs
+                            if w.matches(b64d(e["kv"]["key"]))]
+                    if mine:
+                        w.q.put((rev, mine))
+            self._watchers.append(w)
+        return w
+
+    def _remove_watcher(self, w: V3Watcher) -> None:
+        with self._watch_lock:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+    def _events_between(self, lo: int, hi: int):
+        """Decoded events grouped by main revision in (lo, hi] — read
+        straight from the backend's revision-ordered key bucket (the WAL
+        of the MVCC store). Yields (rev, [event_dict]) in order."""
+        if hi <= lo:
+            return
+        from etcd_tpu.storage.kvstore import DELETE as EV_DELETE
+        from etcd_tpu.storage.kvstore import KEY_BUCKET, _decode_event
+        from etcd_tpu.storage.revision import Revision, rev_to_bytes
+        with self.kv.b.batch_tx as tx:
+            keys, vals = tx.unsafe_range(
+                KEY_BUCKET, rev_to_bytes(Revision(lo + 1, 0)),
+                rev_to_bytes(Revision(hi + 1, 0)))
+        cur_rev, batch = None, []
+        for kb, vb in zip(keys, vals):
+            if len(kb) != 17:
+                continue
+            from etcd_tpu.storage.revision import bytes_to_rev
+            rev = bytes_to_rev(kb)
+            etype, kv = _decode_event(vb)
+            ev = {"type": "DELETE" if etype == EV_DELETE else "PUT",
+                  "kv": self._kv_json(kv)}
+            if rev.main != cur_rev:
+                if batch:
+                    yield cur_rev, batch
+                cur_rev, batch = rev.main, []
+            batch.append(ev)
+        if batch:
+            yield cur_rev, batch
+
+    def _publish(self, lo: int, hi: int) -> None:
+        """Fan out the events a just-finished apply produced in (lo, hi]."""
+        import queue as _q
+        with self._watch_lock:
+            if self._watchers:   # no watchers: skip the backend re-read
+                dead = []
+                for rev, evs in self._events_between(lo, hi):
+                    for w in self._watchers:
+                        mine = [e for e in evs
+                                if w.matches(b64d(e["kv"]["key"]))]
+                        if mine:
+                            try:
+                                w.q.put_nowait((rev, mine))
+                            except _q.Full:
+                                # Consumer stalled past the bound: cancel
+                                # the watcher instead of buffering forever
+                                # (its stream loop sees `cancelled`).
+                                w.cancelled = True
+                                dead.append(w)
+                for w in dead:
+                    if w in self._watchers:
+                        self._watchers.remove(w)
+            self._published_rev = max(self._published_rev, hi)
 
     # -- reads (serializable; linearizable reads ride apply()) --------------
 
@@ -194,6 +318,7 @@ class V3Applier:
             # consistent-index record — recording one would turn every
             # linearizable read into a durable write on every member.
             return self.range(op)
+        rev0 = self.kv.current_rev.main
         with self.kv.atomic() as tx:
             try:
                 result = self._dispatch(op)
@@ -214,6 +339,9 @@ class V3Applier:
                 self.kv.b.rollback()
                 raise
             self._record_index(tx, index)
+        rev1 = self.kv.current_rev.main
+        if rev1 > rev0:
+            self._publish(rev0, rev1)
         return result
 
     def _record_index(self, tx, index: int) -> None:

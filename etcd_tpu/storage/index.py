@@ -189,8 +189,9 @@ class TreeIndex:
 
     def range(self, key: bytes, end: Optional[bytes], at_rev: int
               ) -> Tuple[List[bytes], List[Revision]]:
-        """end None → point lookup; else half-open [key, end)
-        (reference index.go Range)."""
+        """end None → point lookup; end b"\\x00" → every key >= `key` (the
+        etcd whole-keyspace sentinel); else half-open [key, end)
+        (reference index.go Range + etcd's RangeEnd convention)."""
         with self._lock:
             if end is None:
                 try:
@@ -198,10 +199,12 @@ class TreeIndex:
                 except RevisionNotFoundError:
                     return [], []
                 return [key], [rev]
+            unbounded = end == b"\x00"
             keys: List[bytes] = []
             revs: List[Revision] = []
             i = bisect.bisect_left(self._sorted, key)
-            while i < len(self._sorted) and self._sorted[i] < end:
+            while i < len(self._sorted) and (unbounded
+                                             or self._sorted[i] < end):
                 k = self._sorted[i]
                 try:
                     rev, _, _ = self._map[k].get(at_rev)

@@ -250,10 +250,113 @@ def test_range_count_and_more_are_etcd_semantics(cluster):
     assert rr["count"] == 4 and rr["more"] is True and len(rr["kvs"]) == 2
 
 
-def test_unimplemented_watch_and_lease(cluster):
+def _watch_stream(cluster, body, n_lines, out, member=0, timeout=15):
+    """Read n_lines JSON lines from a /v3/watch chunked stream into out."""
+    import urllib.request
+    r = urllib.request.Request(
+        cluster[member].client_urls[0] + "/v3/watch",
+        data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        for _ in range(n_lines):
+            out.append(json.loads(resp.readline()))
+
+
+def test_v3_watch_live_events(cluster):
+    import threading
+    import time
+
+    got = []
+    done = threading.Event()
+
+    def streamer():
+        # created line + 3 event lines (two puts + one delete revision)
+        _watch_stream(cluster, {"key": e("w/"), "range_end": e("w0")},
+                      4, got)
+        done.set()
+
+    th = threading.Thread(target=streamer, daemon=True)
+    th.start()
+    time.sleep(0.3)
+    v3(cluster, "put", {"key": e("w/a"), "value": e("1")})
+    v3(cluster, "put", {"key": e("w/b"), "value": e("2")})
+    v3(cluster, "put", {"key": e("outside"), "value": e("x")})  # filtered
+    v3(cluster, "deleterange", {"key": e("w/a")})
+    assert done.wait(15), "watch stream incomplete"
+    assert got[0]["result"]["created"] is True
+    evs = [ev for line in got[1:] for ev in line["result"]["events"]]
+    assert [(ev["type"], d(ev["kv"]["key"])) for ev in evs] == [
+        ("PUT", "w/a"), ("PUT", "w/b"), ("DELETE", "w/a")]
+    revs = [line["result"]["header"]["revision"] for line in got[1:]]
+    assert revs == sorted(revs)
+
+
+def test_v3_watch_historical_replay(cluster):
+    st, _, b = v3(cluster, "put", {"key": e("h/one"), "value": e("1")})
+    rev1 = b["header"]["revision"]
+    v3(cluster, "put", {"key": e("h/two"), "value": e("2")})
+    # A txn writes two events in ONE revision; the watch batch groups them.
+    v3(cluster, "txn", {"compare": [], "failure": [], "success": [
+        {"request_put": {"key": e("h/t1"), "value": e("a")}},
+        {"request_put": {"key": e("h/t2"), "value": e("b")}}]})
+    got = []
+    _watch_stream(cluster, {"key": e("h/"), "range_end": e("h0"),
+                            "start_revision": rev1}, 4, got)
+    assert got[0]["result"]["created"] is True
+    assert [d(ev["kv"]["key"]) for ev in got[1]["result"]["events"]] == \
+        ["h/one"]
+    assert [d(ev["kv"]["key"]) for ev in got[2]["result"]["events"]] == \
+        ["h/two"]
+    txn_events = got[3]["result"]["events"]
+    assert [d(ev["kv"]["key"]) for ev in txn_events] == ["h/t1", "h/t2"]
+    assert len({ev["kv"]["mod_revision"] for ev in txn_events}) == 1
+
+
+def test_whole_keyspace_sentinel(cluster):
+    """etcd's range_end="\\0" convention: everything >= key — honored by
+    range, deleterange and watch."""
+    import threading
+    import time
+
+    v3(cluster, "put", {"key": e("zz/sentinel"), "value": e("1")})
+    st, _, b = v3(cluster, "range",
+                  {"key": e("zz/"), "range_end": e("\x00")})
+    assert st == 200 and b["count"] >= 1
+    assert any(d(kv["key"]) == "zz/sentinel" for kv in b["kvs"])
+
+    got = []
+    done = threading.Event()
+
+    def streamer():
+        _watch_stream(cluster, {"key": e("zz/"), "range_end": e("\x00")},
+                      2, got)
+        done.set()
+
+    th = threading.Thread(target=streamer, daemon=True)
+    th.start()
+    time.sleep(0.3)
+    v3(cluster, "put", {"key": e("zz/watched"), "value": e("2")})
+    assert done.wait(15)
+    assert d(got[1]["result"]["events"][0]["kv"]["key"]) == "zz/watched"
+
+    st, _, b = v3(cluster, "deleterange",
+                  {"key": e("zz/"), "range_end": e("\x00")})
+    assert st == 200 and b["deleted"] >= 2
+
+
+def test_v3_watch_compacted_start_errors(cluster):
+    st, _, b = v3(cluster, "put", {"key": e("wc"), "value": e("1")})
+    v3(cluster, "put", {"key": e("wc"), "value": e("2")})
+    rev = b["header"]["revision"]
+    v3(cluster, "compact", {"revision": rev})
     st, _, b = req("POST", cluster[0].client_urls[0] + "/v3/watch",
-                   b"{}", {"Content-Type": "application/json"})
-    assert st == 501 and b["code"] == 12
+                   json.dumps({"key": e("wc"),
+                               "start_revision": rev}).encode(),
+                   {"Content-Type": "application/json"})
+    assert st == 400 and b["code"] == 11
+
+
+def test_unimplemented_lease(cluster):
     st, _, b = req("POST", cluster[0].client_urls[0] + "/v3/lease/grant",
                    b"{}", {"Content-Type": "application/json"})
     assert st == 501
