@@ -11,8 +11,14 @@ etcd JSON-gateway way: POST with a JSON body, bytes fields base64).
                              {"result": {header, events}} JSON lines
                              (created confirmation first; start_revision
                              replays history)
-    POST /v3/lease/*         501 (declared by the RFC, implementation
-                             pending — the reference implements neither)
+    POST /v3/lease/grant     LeaseCreateRequest -> {lease_id, ttl}
+    POST /v3/lease/revoke    LeaseRevokeRequest -> header (attached keys
+                             deleted at one revision)
+    POST /v3/lease/attach    LeaseAttachRequest -> header
+    POST /v3/lease/keepalive LeaseKeepAliveRequest -> {lease_id, ttl}
+                             (single-shot POST; expiry is enacted by the
+                             leader as a replicated revoke)
+    POST /v3/lease/txn       501 (LeaseTnx: declared by the RFC only)
 
 Mutations (and linearizable ranges) ride the member's consensus log as
 METHOD_V3 requests; serializable ranges (`"serializable": true`) read the
@@ -72,16 +78,32 @@ class V3API:
             "kv/range": "range", "kv/put": "put",
             "kv/deleterange": "deleterange", "kv/txn": "txn",
             "kv/compact": "compact",
+            "lease/grant": "lease_create", "lease/create": "lease_create",
+            "lease/revoke": "lease_revoke",
+            "lease/attach": "lease_attach",
+            "lease/keepalive": "lease_keepalive",
         }.get(suffix)
         if route is None:
-            if suffix.startswith("lease"):
-                self._err(ctx, 501, 12, "v3 lease is declared by the RFC "
+            if suffix == "lease/txn":
+                self._err(ctx, 501, 12, "LeaseTnx is declared by the RFC "
                                         "but not yet implemented")
             else:
                 self._err(ctx, 404, 3, f"unknown v3 path {suffix!r}")
             return
         op = dict(body)
         op["type"] = route
+        # Proposer-side fields: the lease id and the timestamps come from
+        # THIS gateway so the replicated op is deterministic on every
+        # member and replay (clocks never enter the apply path). Stamped
+        # UNCONDITIONALLY with the server's injectable clock — the same
+        # clock expiry compares against; honoring a client-supplied
+        # timestamp would let one request mint an immortal lease.
+        if route == "lease_create":
+            if not op.get("lease_id"):
+                op["lease_id"] = self.server.reqid.next()
+            op["grant_time"] = self.server.clock()
+        elif route == "lease_keepalive":
+            op["renew_time"] = self.server.clock()
         try:
             # Reject malformed ops HERE — nothing unvalidated may enter
             # the consensus log (apply re-validates; defense in depth).
@@ -125,7 +147,7 @@ class V3API:
             end = (base64.b64decode(body["range_end"])
                    if body.get("range_end") else None)
             start = int(body.get("start_revision") or 0)
-            w = self.server.v3.watch(key, end, start)
+            w, replay = self.server.v3.watch(key, end, start)
         except _V3E as e:
             self._v3err(ctx, e)
             return
@@ -136,6 +158,14 @@ class V3API:
                 "created": True}}
             if not ctx.write_chunk(json.dumps(created).encode() + b"\n"):
                 return
+            # Historical replay streams straight from the backend (lazy,
+            # chunked) before the live queue takes over at the fence.
+            for rev, events in (replay or ()):
+                line = json.dumps({"result": {
+                    "header": {"revision": rev},
+                    "events": events}}).encode() + b"\n"
+                if not ctx.write_chunk(line):
+                    return
             while True:
                 batch = w.next_batch(timeout=0.5)
                 if batch is not None:

@@ -625,6 +625,18 @@ class EtcdServer:
                         self.node.propose(r.encode())
                     except ProposalDroppedError:
                         pass
+                # v3 lease expiry: the leader's clock decides, the log
+                # enacts (replicated revoke; every member deletes the
+                # attached keys deterministically) — the v3 analogue of
+                # the SYNC above.
+                for lid in self.v3.expired_leases(self.clock()):
+                    r = Request(id=self.reqid.next(), method=METHOD_V3,
+                                v3={"type": "lease_revoke",
+                                    "lease_id": lid})
+                    try:
+                        self.node.propose(r.encode())
+                    except ProposalDroppedError:
+                        pass
         elif self.leader_id != raftpb.NO_LEADER:
             self.stats.become_follower(self.leader_id)
             self.lead_elected_ev.set()

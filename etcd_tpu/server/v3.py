@@ -28,6 +28,7 @@ from etcd_tpu.storage import CompactedError, KVStore
 from etcd_tpu.storage.kvstore import META_BUCKET
 
 CONSISTENT_INDEX_KEY = b"consistentIndex"
+LEASE_BUCKET = b"lease"
 
 # Compare targets / results (v3api.proto Compare).
 _TARGETS = ("VERSION", "CREATE", "MOD", "VALUE")
@@ -90,6 +91,22 @@ def validate_op(op: Dict[str, Any]) -> None:
         _need_int(op, "revision")
     elif t == "compact":
         _need_int(op, "revision")
+    elif t == "lease_create":
+        _need_int(op, "ttl")
+        _need_int(op, "lease_id")
+        if not isinstance(op.get("grant_time"), (int, float)):
+            raise V3Error(3, "lease_create needs a numeric grant_time")
+        if int(op.get("ttl", 0)) <= 0:
+            raise V3Error(3, "lease ttl must be > 0")
+    elif t == "lease_revoke":
+        _need_int(op, "lease_id")
+    elif t == "lease_attach":
+        _need_int(op, "lease_id")
+        _need_b64(op, "key", required=True)
+    elif t == "lease_keepalive":
+        _need_int(op, "lease_id")
+        if not isinstance(op.get("renew_time"), (int, float)):
+            raise V3Error(3, "lease_keepalive needs a numeric renew_time")
     elif t == "txn":
         for c in _need_list(op, "compare"):
             if not isinstance(c, dict):
@@ -180,64 +197,94 @@ class V3Applier:
         self._watch_lock = threading.Lock()
         self._watchers: List[V3Watcher] = []
         self._published_rev = self.kv.current_rev.main
+        # Leases (RFC LeaseCreate/Revoke/Attach/KeepAlive): replicated
+        # state with PROPOSER timestamps in the ops (deterministic across
+        # members and replays); expiry is decided by the leader's clock
+        # and enacted as a replicated lease_revoke (the v2 SYNC pattern,
+        # reference server.go:667-681).
+        self._lease_lock = threading.Lock()
+        self.leases: Dict[int, dict] = {}
+        with self.kv.b.batch_tx as tx:
+            tx.unsafe_create_bucket(LEASE_BUCKET)
+            lkeys, lvals = tx.unsafe_range(LEASE_BUCKET, b"",
+                                           b"\xff" * 9)
+        import json as _json
+        for kb, vb in zip(lkeys, lvals):
+            self.leases[struct.unpack(">Q", kb)[0]] = _json.loads(vb)
 
     def close(self) -> None:
         self.kv.close()
 
     # -- watch (RFC WatchRange) --------------------------------------------
 
-    def watch(self, key: bytes, end: Optional[bytes],
-              start_rev: int = 0) -> V3Watcher:
-        """Register a watcher; start_rev > 0 first replays the historical
-        events in (start_rev-1, now] from the backend (compacted start
-        revisions error, like range)."""
+    def watch(self, key: bytes, end: Optional[bytes], start_rev: int = 0):
+        """Register a watcher. Returns (watcher, replay): replay is None,
+        or a lazy generator over the historical (start_rev-1, fence]
+        events the CALLER must stream before consuming the queue.
+
+        The fence (_published_rev at registration) splits history from
+        live: live events land in the queue, history is read lazily from
+        the backend in chunks OUTSIDE the lock — replaying under the lock
+        (or into the bounded queue before a consumer exists) would block
+        the apply thread's _publish, stalling consensus on this member."""
         w = V3Watcher(self, key, end)
         with self._watch_lock:
-            if start_rev > 0:
-                if start_rev <= self.kv.compact_main_rev:
-                    raise V3Error(11, f"required revision {start_rev} has "
-                                      "been compacted")
-                for rev, evs in self._events_between(start_rev - 1,
-                                                     self._published_rev):
-                    mine = [e for e in evs
-                            if w.matches(b64d(e["kv"]["key"]))]
-                    if mine:
-                        w.q.put((rev, mine))
+            if start_rev > 0 and start_rev <= self.kv.compact_main_rev:
+                raise V3Error(11, f"required revision {start_rev} has "
+                                  "been compacted")
+            fence = self._published_rev
             self._watchers.append(w)
-        return w
+        if start_rev <= 0:
+            return w, None
+
+        def replay():
+            for rev, evs in self._events_between(start_rev - 1, fence):
+                mine = [ev for ev in evs
+                        if w.matches(b64d(ev["kv"]["key"]))]
+                if mine:
+                    yield rev, mine
+        return w, replay()
 
     def _remove_watcher(self, w: V3Watcher) -> None:
         with self._watch_lock:
             if w in self._watchers:
                 self._watchers.remove(w)
 
-    def _events_between(self, lo: int, hi: int):
+    def _events_between(self, lo: int, hi: int, chunk: int = 4096):
         """Decoded events grouped by main revision in (lo, hi] — read
-        straight from the backend's revision-ordered key bucket (the WAL
-        of the MVCC store). Yields (rev, [event_dict]) in order."""
+        from the backend's revision-ordered key bucket (the WAL of the
+        MVCC store) in `chunk`-row pages, so a long historical span never
+        loads into memory at once or holds the batch-tx lock for its
+        whole length. Yields (rev, [event_dict]) in order."""
         if hi <= lo:
             return
         from etcd_tpu.storage.kvstore import DELETE as EV_DELETE
         from etcd_tpu.storage.kvstore import KEY_BUCKET, _decode_event
-        from etcd_tpu.storage.revision import Revision, rev_to_bytes
-        with self.kv.b.batch_tx as tx:
-            keys, vals = tx.unsafe_range(
-                KEY_BUCKET, rev_to_bytes(Revision(lo + 1, 0)),
-                rev_to_bytes(Revision(hi + 1, 0)))
+        from etcd_tpu.storage.revision import (Revision, bytes_to_rev,
+                                               rev_to_bytes)
+        cursor = rev_to_bytes(Revision(lo + 1, 0))
+        end = rev_to_bytes(Revision(hi + 1, 0))
         cur_rev, batch = None, []
-        for kb, vb in zip(keys, vals):
-            if len(kb) != 17:
-                continue
-            from etcd_tpu.storage.revision import bytes_to_rev
-            rev = bytes_to_rev(kb)
-            etype, kv = _decode_event(vb)
-            ev = {"type": "DELETE" if etype == EV_DELETE else "PUT",
-                  "kv": self._kv_json(kv)}
-            if rev.main != cur_rev:
-                if batch:
-                    yield cur_rev, batch
-                cur_rev, batch = rev.main, []
-            batch.append(ev)
+        while True:
+            with self.kv.b.batch_tx as tx:
+                keys, vals = tx.unsafe_range(KEY_BUCKET, cursor, end,
+                                             limit=chunk)
+            for kb, vb in zip(keys, vals):
+                if len(kb) != 17:
+                    continue
+                rev = bytes_to_rev(kb)
+                etype, kv = _decode_event(vb)
+                ev = {"type": "DELETE" if etype == EV_DELETE else "PUT",
+                      "kv": self._kv_json(kv)}
+                if rev.main != cur_rev:
+                    if batch:
+                        yield cur_rev, batch
+                    cur_rev, batch = rev.main, []
+                batch.append(ev)
+            if len(keys) < chunk:
+                break
+            last = bytes_to_rev(keys[-1])
+            cursor = rev_to_bytes(Revision(last.main, last.sub + 1))
         if batch:
             yield cur_rev, batch
 
@@ -369,7 +416,68 @@ class V3Applier:
             return {"header": {"revision": self.kv.current_rev.main}}
         if t == "txn":
             return self._apply_txn(op)
+        if t.startswith("lease_"):
+            return self._apply_lease(t, op)
         raise V3Error(3, f"unknown v3 op type {t!r}")
+
+    # -- leases -------------------------------------------------------------
+
+    def _persist_lease(self, lid: int, rec: Optional[dict]) -> None:
+        import json as _json
+        with self.kv.b.batch_tx as tx:
+            if rec is None:
+                tx.unsafe_delete(LEASE_BUCKET, struct.pack(">Q", lid))
+            else:
+                tx.unsafe_put(LEASE_BUCKET, struct.pack(">Q", lid),
+                              _json.dumps(rec).encode())
+
+    def _apply_lease(self, t: str, op: Dict[str, Any]) -> Dict[str, Any]:
+        lid = int(op.get("lease_id", 0))
+        with self._lease_lock:
+            if t == "lease_create":
+                if lid in self.leases:
+                    raise V3Error(3, f"lease {lid:x} already exists")
+                rec = {"ttl": int(op["ttl"]),
+                       "renew": float(op["grant_time"]), "keys": []}
+                self.leases[lid] = rec
+                self._persist_lease(lid, rec)
+                return {"header": self._hdr(), "lease_id": lid,
+                        "ttl": rec["ttl"]}
+            rec = self.leases.get(lid)
+            if rec is None:
+                raise V3Error(5, f"lease {lid:x} not found")
+            if t == "lease_keepalive":
+                rec["renew"] = max(rec["renew"], float(op["renew_time"]))
+                self._persist_lease(lid, rec)
+                return {"header": self._hdr(), "lease_id": lid,
+                        "ttl": rec["ttl"]}
+            if t == "lease_attach":
+                if op["key"] not in rec["keys"]:
+                    rec["keys"].append(op["key"])
+                self._persist_lease(lid, rec)
+                return {"header": self._hdr(), "lease_id": lid}
+            # lease_revoke: delete every attached key at ONE revision,
+            # then drop the lease (RFC: "All keys attached to the lease
+            # will be expired and deleted").
+            tid = self.kv.txn_begin()
+            try:
+                for k64 in rec["keys"]:
+                    self.kv.txn_delete_range(tid, b64d(k64))
+            finally:
+                self.kv.txn_end(tid)
+            del self.leases[lid]
+            self._persist_lease(lid, None)
+            return {"header": self._hdr(), "lease_id": lid}
+
+    def _hdr(self) -> Dict[str, int]:
+        return {"revision": self.kv.current_rev.main}
+
+    def expired_leases(self, now: float) -> List[int]:
+        """Lease ids past their deadline — the leader's tick monitor turns
+        these into replicated lease_revoke proposals."""
+        with self._lease_lock:
+            return [lid for lid, rec in self.leases.items()
+                    if now > rec["renew"] + rec["ttl"]]
 
     # -- txn ----------------------------------------------------------------
 

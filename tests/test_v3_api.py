@@ -356,8 +356,138 @@ def test_v3_watch_compacted_start_errors(cluster):
     assert st == 400 and b["code"] == 11
 
 
-def test_unimplemented_lease(cluster):
-    st, _, b = req("POST", cluster[0].client_urls[0] + "/v3/lease/grant",
+def lease_call(cluster, path, body, member=0):
+    return req("POST", cluster[member].client_urls[0] + "/v3/lease/" + path,
+               json.dumps(body).encode(), {"Content-Type": "application/json"})
+
+
+def test_lease_grant_attach_revoke(cluster):
+    st, _, b = lease_call(cluster, "grant", {"ttl": 60})
+    assert st == 200 and b["ttl"] == 60
+    lid = b["lease_id"]
+    v3(cluster, "put", {"key": e("lease/a"), "value": e("1")})
+    v3(cluster, "put", {"key": e("lease/b"), "value": e("2")})
+    for k in ("lease/a", "lease/b"):
+        st, _, b = lease_call(cluster, "attach", {"lease_id": lid,
+                                                  "key": e(k)})
+        assert st == 200, (st, b)
+    st, _, b = lease_call(cluster, "keepalive", {"lease_id": lid})
+    assert st == 200 and b["ttl"] == 60
+    st, _, b = lease_call(cluster, "revoke", {"lease_id": lid})
+    assert st == 200
+    # Attached keys deleted, at ONE revision.
+    st, _, b = v3(cluster, "range", {"key": e("lease/"),
+                                     "range_end": e("lease0")})
+    assert b["count"] == 0
+    # Revoking again: clean not-found error.
+    st, _, b = lease_call(cluster, "revoke", {"lease_id": lid})
+    assert st == 400 and b["code"] == 5
+    # Unknown-lease keepalive errors too.
+    st, _, b = lease_call(cluster, "keepalive", {"lease_id": 999999})
+    assert st == 400 and b["code"] == 5
+
+
+def test_lease_expiry_deletes_keys(cluster):
+    """The leader's tick monitor must revoke an expired lease through
+    consensus and delete its keys on every member."""
+    import time
+
+    st, _, b = lease_call(cluster, "grant", {"ttl": 1})
+    lid = b["lease_id"]
+    v3(cluster, "put", {"key": e("expire/me"), "value": e("x")})
+    st, _, b = lease_call(cluster, "attach", {"lease_id": lid,
+                                              "key": e("expire/me")})
+    assert st == 200
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        st, _, b = v3(cluster, "range", {"key": e("expire/me")})
+        if b["count"] == 0:
+            break
+        time.sleep(0.2)
+    assert b["count"] == 0, "lease expiry never deleted the key"
+    # Every member converged (serializable reads, each member's own store).
+    for m in range(3):
+        st, _, b = v3(cluster, "range", {"key": e("expire/me"),
+                                         "serializable": True}, member=m)
+        assert b["count"] == 0, f"member {m} still has the key"
+
+
+def test_lease_client_timestamps_are_ignored(cluster):
+    """A client must not be able to mint an immortal lease by supplying
+    its own grant_time — the gateway stamps the server clock
+    unconditionally."""
+    import time
+
+    st, _, b = lease_call(cluster, "grant",
+                          {"ttl": 1, "grant_time": 1e18})
+    lid = b["lease_id"]
+    v3(cluster, "put", {"key": e("not-immortal"), "value": e("x")})
+    lease_call(cluster, "attach", {"lease_id": lid,
+                                   "key": e("not-immortal")})
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        st, _, b = v3(cluster, "range", {"key": e("not-immortal")})
+        if b["count"] == 0:
+            break
+        time.sleep(0.2)
+    assert b["count"] == 0, "client-supplied grant_time was honored"
+
+
+def test_lease_keepalive_defers_expiry(cluster):
+    import time
+
+    st, _, b = lease_call(cluster, "grant", {"ttl": 2})
+    lid = b["lease_id"]
+    v3(cluster, "put", {"key": e("keptalive"), "value": e("x")})
+    lease_call(cluster, "attach", {"lease_id": lid, "key": e("keptalive")})
+    # Keep renewing past several would-be expiries.
+    for _ in range(6):
+        st, _, b = lease_call(cluster, "keepalive", {"lease_id": lid})
+        assert st == 200
+        time.sleep(0.5)
+        st, _, b = v3(cluster, "range", {"key": e("keptalive")})
+        assert b["count"] == 1, "key expired despite keepalives"
+    lease_call(cluster, "revoke", {"lease_id": lid})
+
+
+def test_lease_survives_restart(tmp_path):
+    from etcd_tpu.embed import Etcd, EtcdConfig
+
+    pp, cp = free_ports(2)
+
+    def mk():
+        return Etcd(EtcdConfig(
+            name="ls", data_dir=str(tmp_path / "ls"),
+            initial_cluster={"ls": [f"http://127.0.0.1:{pp}"]},
+            listen_client_urls=[f"http://127.0.0.1:{cp}"],
+            tick_ms=10, request_timeout=5.0))
+
+    m = mk()
+    m.start()
+    assert m.wait_leader(10)
+    cl = [m]
+    st, _, b = lease_call(cl, "grant", {"ttl": 3600})
+    lid = b["lease_id"]
+    v3(cl, "put", {"key": e("durable-lease"), "value": e("x")})
+    lease_call(cl, "attach", {"lease_id": lid, "key": e("durable-lease")})
+    m.stop()
+
+    m2 = mk()
+    m2.start()
+    try:
+        assert m2.wait_leader(10)
+        cl = [m2]
+        # Lease state survived: revoke still knows the attachment.
+        st, _, b = lease_call(cl, "revoke", {"lease_id": lid})
+        assert st == 200, (st, b)
+        st, _, b = v3(cl, "range", {"key": e("durable-lease")})
+        assert b["count"] == 0
+    finally:
+        m2.stop()
+
+
+def test_unimplemented_lease_txn(cluster):
+    st, _, b = req("POST", cluster[0].client_urls[0] + "/v3/lease/txn",
                    b"{}", {"Content-Type": "application/json"})
     assert st == 501
 
