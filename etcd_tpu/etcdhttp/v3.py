@@ -18,7 +18,10 @@ etcd JSON-gateway way: POST with a JSON body, bytes fields base64).
     POST /v3/lease/keepalive LeaseKeepAliveRequest -> {lease_id, ttl}
                              (single-shot POST; expiry is enacted by the
                              leader as a replicated revoke)
-    POST /v3/lease/txn       501 (LeaseTnx: declared by the RFC only)
+    POST /v3/lease/txn       LeaseTnxRequest {request, success, failure}
+                             -> {header, response, attach_responses}
+
+Every rpc the RFC declares is served.
 
 Mutations (and linearizable ranges) ride the member's consensus log as
 METHOD_V3 requests; serializable ranges (`"serializable": true`) read the
@@ -82,28 +85,22 @@ class V3API:
             "lease/revoke": "lease_revoke",
             "lease/attach": "lease_attach",
             "lease/keepalive": "lease_keepalive",
+            "lease/txn": "lease_txn",
         }.get(suffix)
         if route is None:
-            if suffix == "lease/txn":
-                self._err(ctx, 501, 12, "LeaseTnx is declared by the RFC "
-                                        "but not yet implemented")
-            else:
-                self._err(ctx, 404, 3, f"unknown v3 path {suffix!r}")
+            self._err(ctx, 404, 3, f"unknown v3 path {suffix!r}")
             return
         op = dict(body)
         op["type"] = route
-        # Proposer-side fields: the lease id and the timestamps come from
-        # THIS gateway so the replicated op is deterministic on every
-        # member and replay (clocks never enter the apply path). Stamped
-        # UNCONDITIONALLY with the server's injectable clock — the same
-        # clock expiry compares against; honoring a client-supplied
-        # timestamp would let one request mint an immortal lease.
-        if route == "lease_create":
-            if not op.get("lease_id"):
-                op["lease_id"] = self.server.reqid.next()
-            op["grant_time"] = self.server.clock()
-        elif route == "lease_keepalive":
-            op["renew_time"] = self.server.clock()
+        # Lease ops carry no clocks at all (expiry is judged purely on the
+        # leader's clock against renewal-seq transitions); the gateway
+        # only assigns a fresh id when the client didn't pick one, and
+        # strips any client-supplied revoke fence (explicit revokes are
+        # unconditional; only the leader's expiry monitor fences).
+        if route == "lease_create" and not op.get("lease_id"):
+            op["lease_id"] = self.server.reqid.next()
+        elif route == "lease_revoke":
+            op.pop("seq", None)
         try:
             # Reject malformed ops HERE — nothing unvalidated may enter
             # the consensus log (apply re-validates; defense in depth).
@@ -159,13 +156,22 @@ class V3API:
             if not ctx.write_chunk(json.dumps(created).encode() + b"\n"):
                 return
             # Historical replay streams straight from the backend (lazy,
-            # chunked) before the live queue takes over at the fence.
-            for rev, events in (replay or ()):
-                line = json.dumps({"result": {
-                    "header": {"revision": rev},
-                    "events": events}}).encode() + b"\n"
-                if not ctx.write_chunk(line):
-                    return
+            # chunked) before the live queue takes over at the fence. A
+            # compaction overtaking the replay cancels the watch (etcd's
+            # behavior) rather than delivering a gap-ridden history.
+            try:
+                for rev, events in (replay or ()):
+                    line = json.dumps({"result": {
+                        "header": {"revision": rev},
+                        "events": events}}).encode() + b"\n"
+                    if not ctx.write_chunk(line):
+                        return
+            except _V3E as e:
+                ctx.write_chunk(json.dumps(
+                    {"result": {"canceled": True,
+                                "reason": e.msg}}).encode() + b"\n")
+                ctx.end_stream()
+                return
             while True:
                 batch = w.next_batch(timeout=0.5)
                 if batch is not None:

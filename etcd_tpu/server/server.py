@@ -131,6 +131,14 @@ class EtcdServer:
         # state) until restarted — the process-level analogue of the
         # reference's panic-on-backend-error.
         self._fatal = False
+        # Leader-local lease bookkeeping: lease_id -> (seq, clock time the
+        # LEADER observed that seq). Replicated lease state carries no
+        # clocks; only this member's clock decides expiry, re-seeded on
+        # every leadership change (leases extend across elections, never
+        # silently shorten). _lease_revoke_inflight dedups re-proposals.
+        self._was_leader = False
+        self._lease_seen: Dict[int, Tuple[int, float]] = {}
+        self._lease_revoke_inflight: Dict[int, float] = {}
         self._sync_elapsed = 0
         self.lead_elected_ev = threading.Event()
         self._force_version_ev = threading.Event()  # reference forceVersionC
@@ -615,6 +623,14 @@ class EtcdServer:
             if not self.lead_elected_ev.is_set():
                 self._force_version_ev.set()   # negotiate immediately
             self.lead_elected_ev.set()
+            if not self._was_leader:
+                # Fresh leadership: base every lease deadline on THIS
+                # clock, treating all as just-renewed (grace window).
+                self._was_leader = True
+                now = self.clock()
+                self._lease_seen = {lid: (seq, now) for lid, seq in
+                                    self.v3.lease_seqs().items()}
+                self._lease_revoke_inflight.clear()
             self._sync_elapsed += 1
             if (self._sync_elapsed >= self.cfg.sync_ticks):
                 self._sync_elapsed = 0
@@ -626,22 +642,49 @@ class EtcdServer:
                     except ProposalDroppedError:
                         pass
                 # v3 lease expiry: the leader's clock decides, the log
-                # enacts (replicated revoke; every member deletes the
-                # attached keys deterministically) — the v3 analogue of
-                # the SYNC above.
-                for lid in self.v3.expired_leases(self.clock()):
-                    r = Request(id=self.reqid.next(), method=METHOD_V3,
-                                v3={"type": "lease_revoke",
-                                    "lease_id": lid})
-                    try:
-                        self.node.propose(r.encode())
-                    except ProposalDroppedError:
-                        pass
+                # enacts — the v3 analogue of the SYNC above. A lease is
+                # expired when ITS SEQ has not changed for > ttl on this
+                # leader's clock; the revoke carries that seq as a fence
+                # so a concurrently-committed keepalive wins.
+                self._check_lease_expiry()
         elif self.leader_id != raftpb.NO_LEADER:
+            self._was_leader = False
             self.stats.become_follower(self.leader_id)
             self.lead_elected_ev.set()
         if not self._published and self.leader_id != raftpb.NO_LEADER:
             self._publish()
+
+    def _check_lease_expiry(self) -> None:
+        """Leader-only: compare each lease's renewal seq against the last
+        observation on this clock; propose ONE fenced revoke per expiry
+        (re-proposed only after a cool-off, in case the first is lost)."""
+        now = self.clock()
+        seqs = self.v3.lease_seqs()
+        for lid in list(self._lease_seen):
+            if lid not in seqs:
+                self._lease_seen.pop(lid, None)
+                self._lease_revoke_inflight.pop(lid, None)
+        cooloff = max(1.0, 4 * self.cfg.sync_ticks * self.cfg.tick_ms
+                      / 1000.0)
+        for lid, seq in seqs.items():
+            seen = self._lease_seen.get(lid)
+            if seen is None or seen[0] != seq:
+                self._lease_seen[lid] = (seq, now)   # new or renewed
+                continue
+            ttl = self.v3.lease_ttl(lid)
+            if ttl is None or now - seen[1] <= ttl:
+                continue
+            last = self._lease_revoke_inflight.get(lid, 0.0)
+            if now - last < cooloff:
+                continue   # a revoke is already in flight
+            self._lease_revoke_inflight[lid] = now
+            r = Request(id=self.reqid.next(), method=METHOD_V3,
+                        v3={"type": "lease_revoke", "lease_id": lid,
+                            "seq": seq})
+            try:
+                self.node.propose(r.encode())
+            except ProposalDroppedError:
+                pass
 
     def _publish(self) -> None:
         """Propose our own attributes (reference publish server.go:688-715);

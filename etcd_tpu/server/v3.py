@@ -74,6 +74,16 @@ def _need_int(op: Dict[str, Any], field: str) -> None:
         raise V3Error(3, f"field {field!r} must be an integer")
 
 
+def _need_uint64(op: Dict[str, Any], field: str) -> None:
+    """Bounded int: a replicated id outside uint64 would make the 8-byte
+    persistence key raise struct.error during APPLY on every member — a
+    poison-pill entry. Reject at validation (gateway AND apply)."""
+    _need_int(op, field)
+    v = op.get(field)
+    if v is not None and not 0 <= v < 1 << 64:
+        raise V3Error(3, f"field {field!r} must fit in uint64")
+
+
 def validate_op(op: Dict[str, Any]) -> None:
     """Structural validation of a v3 op. Runs at the GATEWAY (so malformed
     requests are rejected before they enter the consensus log) and again at
@@ -93,20 +103,28 @@ def validate_op(op: Dict[str, Any]) -> None:
         _need_int(op, "revision")
     elif t == "lease_create":
         _need_int(op, "ttl")
-        _need_int(op, "lease_id")
-        if not isinstance(op.get("grant_time"), (int, float)):
-            raise V3Error(3, "lease_create needs a numeric grant_time")
+        _need_uint64(op, "lease_id")
         if int(op.get("ttl", 0)) <= 0:
             raise V3Error(3, "lease ttl must be > 0")
     elif t == "lease_revoke":
-        _need_int(op, "lease_id")
+        _need_uint64(op, "lease_id")
+        _need_int(op, "seq")
     elif t == "lease_attach":
-        _need_int(op, "lease_id")
+        _need_uint64(op, "lease_id")
         _need_b64(op, "key", required=True)
     elif t == "lease_keepalive":
-        _need_int(op, "lease_id")
-        if not isinstance(op.get("renew_time"), (int, float)):
-            raise V3Error(3, "lease_keepalive needs a numeric renew_time")
+        _need_uint64(op, "lease_id")
+    elif t == "lease_txn":
+        req = op.get("request")
+        if not isinstance(req, dict):
+            raise V3Error(3, "lease_txn needs a 'request' TxnRequest")
+        validate_op({**req, "type": "txn"})
+        for branch in ("success", "failure"):
+            for a in _need_list(op, branch):
+                if not isinstance(a, dict):
+                    raise V3Error(3, "attach entries must be objects")
+                _need_int(a, "lease_id")
+                _need_b64(a, "key", required=True)
     elif t == "txn":
         for c in _need_list(op, "compare"):
             if not isinstance(c, dict):
@@ -198,10 +216,15 @@ class V3Applier:
         self._watchers: List[V3Watcher] = []
         self._published_rev = self.kv.current_rev.main
         # Leases (RFC LeaseCreate/Revoke/Attach/KeepAlive): replicated
-        # state with PROPOSER timestamps in the ops (deterministic across
-        # members and replays); expiry is decided by the leader's clock
-        # and enacted as a replicated lease_revoke (the v2 SYNC pattern,
-        # reference server.go:667-681).
+        # state carries NO clocks — only a renewal sequence number bumped
+        # by create/keepalive. The leader alone maps seq transitions to
+        # its own clock and proposes seq-FENCED revokes (the v2 SYNC
+        # pattern, reference server.go:667-681): a keepalive that commits
+        # after the expiry check bumps the seq, so the stale revoke
+        # no-ops deterministically on every member. Cross-member clock
+        # skew cannot enter the protocol; leadership changes re-base all
+        # deadlines on the new leader's clock (leases extend, never
+        # silently shorten — etcd's behavior).
         self._lease_lock = threading.Lock()
         self.leases: Dict[int, dict] = {}
         with self.kv.b.batch_tx as tx:
@@ -239,6 +262,14 @@ class V3Applier:
 
         def replay():
             for rev, evs in self._events_between(start_rev - 1, fence):
+                # A compaction landing MID-replay scrubs rows ahead of the
+                # cursor; silently yielding the gap-ridden remainder would
+                # look like a complete history. Cancel like etcd does
+                # (watch canceled with the compact revision).
+                if start_rev <= self.kv.compact_main_rev:
+                    raise V3Error(11, "watch replay overtaken by "
+                                      "compaction; re-watch from a live "
+                                      "revision")
                 mine = [ev for ev in evs
                         if w.matches(b64d(ev["kv"]["key"]))]
                 if mine:
@@ -385,6 +416,8 @@ class V3Applier:
                 # restart replays the entry from the last commit boundary.
                 self.kv.b.rollback()
                 raise
+            if self.kv.current_rev.main > rev0:
+                self._detach_deleted(rev0, self.kv.current_rev.main)
             self._record_index(tx, index)
         rev1 = self.kv.current_rev.main
         if rev1 > rev0:
@@ -416,9 +449,34 @@ class V3Applier:
             return {"header": {"revision": self.kv.current_rev.main}}
         if t == "txn":
             return self._apply_txn(op)
+        if t == "lease_txn":
+            return self._apply_lease_txn(op)
         if t.startswith("lease_"):
             return self._apply_lease(t, op)
         raise V3Error(3, f"unknown v3 op type {t!r}")
+
+    def _apply_lease_txn(self, op: Dict[str, Any]) -> Dict[str, Any]:
+        """RFC LeaseTnx: a Tnx plus success/failure LeaseAttachRequest
+        lists; the winning branch's attaches execute with the txn. Every
+        referenced lease is checked BEFORE the txn runs so a bad attach
+        cannot abort a txn that already mutated (all-or-nothing)."""
+        with self._lease_lock:
+            for branch in ("success", "failure"):
+                for a in op.get(branch, []):
+                    lid = int(a.get("lease_id", 0))
+                    if lid not in self.leases:
+                        raise V3Error(5, f"lease {lid:x} not found")
+        txn_resp = self._apply_txn(op["request"])
+        attaches = op.get("success" if txn_resp["succeeded"] else "failure",
+                          [])
+        attach_responses = []
+        for a in attaches:
+            attach_responses.append(
+                self._apply_lease("lease_attach",
+                                  {"lease_id": int(a["lease_id"]),
+                                   "key": a["key"]}))
+        return {"header": self._hdr(), "response": txn_resp,
+                "attach_responses": attach_responses}
 
     # -- leases -------------------------------------------------------------
 
@@ -437,28 +495,34 @@ class V3Applier:
             if t == "lease_create":
                 if lid in self.leases:
                     raise V3Error(3, f"lease {lid:x} already exists")
-                rec = {"ttl": int(op["ttl"]),
-                       "renew": float(op["grant_time"]), "keys": []}
+                rec = {"ttl": int(op["ttl"]), "seq": 0, "keys": []}
                 self.leases[lid] = rec
                 self._persist_lease(lid, rec)
                 return {"header": self._hdr(), "lease_id": lid,
-                        "ttl": rec["ttl"]}
+                        "ttl": rec["ttl"], "seq": 0}
             rec = self.leases.get(lid)
             if rec is None:
                 raise V3Error(5, f"lease {lid:x} not found")
             if t == "lease_keepalive":
-                rec["renew"] = max(rec["renew"], float(op["renew_time"]))
+                rec["seq"] += 1
                 self._persist_lease(lid, rec)
                 return {"header": self._hdr(), "lease_id": lid,
-                        "ttl": rec["ttl"]}
+                        "ttl": rec["ttl"], "seq": rec["seq"]}
             if t == "lease_attach":
                 if op["key"] not in rec["keys"]:
                     rec["keys"].append(op["key"])
                 self._persist_lease(lid, rec)
                 return {"header": self._hdr(), "lease_id": lid}
-            # lease_revoke: delete every attached key at ONE revision,
-            # then drop the lease (RFC: "All keys attached to the lease
-            # will be expired and deleted").
+            # lease_revoke. The seq fence: an expiry-driven revoke carries
+            # the seq the leader observed; a keepalive that committed in
+            # between bumped it, so the stale revoke must NOT fire (the
+            # client already got a successful renewal ack).
+            if "seq" in op and int(op["seq"]) != rec["seq"]:
+                return {"header": self._hdr(), "lease_id": lid,
+                        "renewed": True}
+            # Delete every attached key at ONE revision, then drop the
+            # lease (RFC: "All keys attached to the lease will be expired
+            # and deleted").
             tid = self.kv.txn_begin()
             try:
                 for k64 in rec["keys"]:
@@ -472,12 +536,38 @@ class V3Applier:
     def _hdr(self) -> Dict[str, int]:
         return {"revision": self.kv.current_rev.main}
 
-    def expired_leases(self, now: float) -> List[int]:
-        """Lease ids past their deadline — the leader's tick monitor turns
-        these into replicated lease_revoke proposals."""
+    def lease_seqs(self) -> Dict[int, int]:
+        """Snapshot of (lease_id -> renewal seq) for the leader's expiry
+        monitor."""
         with self._lease_lock:
-            return [lid for lid, rec in self.leases.items()
-                    if now > rec["renew"] + rec["ttl"]]
+            return {lid: rec["seq"] for lid, rec in self.leases.items()}
+
+    def lease_ttl(self, lid: int) -> Optional[int]:
+        with self._lease_lock:
+            rec = self.leases.get(lid)
+            return None if rec is None else rec["ttl"]
+
+    def _detach_deleted(self, lo: int, hi: int) -> None:
+        """Detach keys deleted in (lo, hi] from every lease: a later
+        revoke must not delete an unrelated key re-created under the same
+        name (etcd detaches on delete for the same reason). Runs inside
+        the apply's atomic block so the lease-record updates land in the
+        same commit."""
+        with self._lease_lock:
+            if not any(rec["keys"] for rec in self.leases.values()):
+                return
+            deleted = set()
+            for _, evs in self._events_between(lo, hi):
+                for ev in evs:
+                    if ev["type"] == "DELETE":
+                        deleted.add(ev["kv"]["key"])
+            if not deleted:
+                return
+            for lid, rec in self.leases.items():
+                kept = [k for k in rec["keys"] if k not in deleted]
+                if len(kept) != len(rec["keys"]):
+                    rec["keys"] = kept
+                    self._persist_lease(lid, rec)
 
     # -- txn ----------------------------------------------------------------
 

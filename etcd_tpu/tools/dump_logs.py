@@ -131,16 +131,52 @@ def dump_engine(data_dir: str, out=sys.stdout) -> int:
     return 0
 
 
+def dump_v3(data_dir: str, out=sys.stdout) -> int:
+    """Inspect a member's v3 backend: consistent index, revision span,
+    live keys, leases (server/v3.py layout)."""
+    import os
+    import struct
+
+    from etcd_tpu.server.v3 import (CONSISTENT_INDEX_KEY, LEASE_BUCKET,
+                                    V3Applier, b64d)
+
+    path = os.path.join(data_dir, "member", "v3", "kv.db")
+    if not os.path.isfile(path):
+        print(f"no member/v3/kv.db under {data_dir}", file=sys.stderr)
+        return 1
+    a = V3Applier(path)
+    try:
+        kv = a.kv
+        print(f"consistentIndex={a.consistent_index}", file=out)
+        print(f"currentRev={kv.current_rev.main} "
+              f"compactedRev={kv.compact_main_rev}", file=out)
+        kvs, rev = kv.range(b"", b"\x00")   # whole keyspace
+        print(f"live keys at rev {rev}: {len(kvs)}", file=out)
+        print("key\tcreate\tmod\tver\tbytes", file=out)
+        for item in kvs:
+            print(f"{item.key.decode(errors='replace')}\t"
+                  f"{item.create_rev}\t{item.mod_rev}\t{item.version}\t"
+                  f"{len(item.value)}", file=out)
+        print(f"leases: {len(a.leases)}", file=out)
+        for lid, rec in sorted(a.leases.items()):
+            keys = [b64d(k).decode(errors="replace") for k in rec["keys"]]
+            print(f"lease {lid:x}: ttl={rec['ttl']} seq={rec['seq']} "
+                  f"keys={keys}", file=out)
+    finally:
+        a.close()
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    if argv and argv[0] == "--engine":
+    if argv and argv[0] in ("--engine", "--v3"):
         if len(argv) != 2:
-            print("usage: python -m etcd_tpu.tools.dump_logs --engine <dir>",
-                  file=sys.stderr)
+            print(f"usage: python -m etcd_tpu.tools.dump_logs {argv[0]} "
+                  "<dir>", file=sys.stderr)
             return 2
-        return dump_engine(argv[1])
+        return (dump_engine if argv[0] == "--engine" else dump_v3)(argv[1])
     if len(argv) != 1:
-        print("usage: python -m etcd_tpu.tools.dump_logs [--engine] "
+        print("usage: python -m etcd_tpu.tools.dump_logs [--engine|--v3] "
               "<data-dir>", file=sys.stderr)
         return 2
     return dump(argv[0])

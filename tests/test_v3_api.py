@@ -412,6 +412,35 @@ def test_lease_expiry_deletes_keys(cluster):
         assert b["count"] == 0, f"member {m} still has the key"
 
 
+def test_lease_detach_on_delete(cluster):
+    """Deleting an attached key detaches it: a later revoke must not
+    delete an unrelated key re-created under the same name."""
+    st, _, b = lease_call(cluster, "grant", {"ttl": 600})
+    lid = b["lease_id"]
+    v3(cluster, "put", {"key": e("detach/k"), "value": e("old")})
+    lease_call(cluster, "attach", {"lease_id": lid, "key": e("detach/k")})
+    v3(cluster, "deleterange", {"key": e("detach/k")})
+    # Recreated with no lease attachment.
+    v3(cluster, "put", {"key": e("detach/k"), "value": e("new-unleased")})
+    st, _, b = lease_call(cluster, "revoke", {"lease_id": lid})
+    assert st == 200
+    st, _, b = v3(cluster, "range", {"key": e("detach/k")})
+    assert b["count"] == 1 and d(b["kvs"][0]["value"]) == "new-unleased", \
+        "revoke deleted a re-created, unleased key"
+
+
+def test_lease_id_bounds_rejected(cluster):
+    """Out-of-uint64 ids must die at validation — if one entered the log,
+    the 8-byte persistence key would poison the apply on every member."""
+    for bad in (-1, 1 << 64):
+        st, _, b = lease_call(cluster, "grant",
+                              {"ttl": 5, "lease_id": bad})
+        assert st == 400 and b["code"] == 3, (bad, st, b)
+    # Cluster alive.
+    st, _, b = v3(cluster, "put", {"key": e("bounds-ok"), "value": e("1")})
+    assert st == 200
+
+
 def test_lease_client_timestamps_are_ignored(cluster):
     """A client must not be able to mint an immortal lease by supplying
     its own grant_time — the gateway stamps the server clock
@@ -486,10 +515,37 @@ def test_lease_survives_restart(tmp_path):
         m2.stop()
 
 
-def test_unimplemented_lease_txn(cluster):
-    st, _, b = req("POST", cluster[0].client_urls[0] + "/v3/lease/txn",
-                   b"{}", {"Content-Type": "application/json"})
-    assert st == 501
+def test_lease_txn(cluster):
+    """RFC LeaseTnx: the winning branch's attaches execute with the txn;
+    a bad attach lease aborts BEFORE the txn mutates."""
+    st, _, b = lease_call(cluster, "grant", {"ttl": 60})
+    lid = b["lease_id"]
+    st, _, b = lease_call(cluster, "txn", {
+        "request": {
+            "compare": [],
+            "success": [{"request_put": {"key": e("lt/k"),
+                                         "value": e("v")}}],
+            "failure": []},
+        "success": [{"lease_id": lid, "key": e("lt/k")}],
+        "failure": []})
+    assert st == 200 and b["response"]["succeeded"] is True, (st, b)
+    assert b["attach_responses"][0]["lease_id"] == lid
+    # The attach is live: revoking deletes the key the txn wrote.
+    lease_call(cluster, "revoke", {"lease_id": lid})
+    st, _, b = v3(cluster, "range", {"key": e("lt/k")})
+    assert b["count"] == 0
+
+    # Unknown attach lease: whole op rejected, txn side-effect free.
+    st, _, b = lease_call(cluster, "txn", {
+        "request": {"compare": [],
+                    "success": [{"request_put": {"key": e("lt/leak"),
+                                                 "value": e("x")}}],
+                    "failure": []},
+        "success": [{"lease_id": 424242, "key": e("lt/leak")}],
+        "failure": []})
+    assert st == 400 and b["code"] == 5, (st, b)
+    st, _, b = v3(cluster, "range", {"key": e("lt/leak")})
+    assert b["count"] == 0, "failed lease_txn leaked its txn mutation"
 
 
 def test_malformed_ops_rejected_before_consensus(cluster):
