@@ -480,8 +480,8 @@ def _apply_proposals(st: GroupState, cfg: KernelConfig, prop_count: jax.Array,
 # raft.go:323-332 becomes one top_k over the peers axis)
 # ---------------------------------------------------------------------------
 
-def _quorum_commit(st: GroupState, cfg: KernelConfig,
-                   active: jax.Array) -> GroupState:
+def _quorum_commit(st: GroupState, cfg: KernelConfig, active: jax.Array,
+                   lead_term0: jax.Array) -> GroupState:
     G, P = st.term.shape
     eye = jnp.eye(P, dtype=bool)[None, :, :]
     target_active = active[:, None, :]
@@ -491,9 +491,16 @@ def _quorum_commit(st: GroupState, cfg: KernelConfig,
     qidx = jnp.broadcast_to((quorum(st) - 1)[:, None, None], (G, P, 1))
     mci = ring_lookup(topk, qidx)[..., 0]
     # Only entries from the leader's own term commit by counting
-    # (raftLog.maybeCommit; Raft paper §5.4.2).
+    # (raftLog.maybeCommit; Raft paper §5.4.2). The reference runs
+    # maybeCommit inside each MsgAppResp (raft.go:514-545), BEFORE a
+    # later message might demote the leader; this deferred phase must not
+    # lose that advance, so an instance demoted DURING the message phase
+    # still commits on behalf of the term it led at round start
+    # (lead_term0): its match row was only updatable by same-term acks,
+    # making this exactly the reference's per-response maybeCommit.
+    eff_term = _where(st.state == LEADER, st.term, lead_term0)
     mci_term = term_at(st, cfg, jnp.maximum(mci, 0))
-    ok = (st.state == LEADER) & (mci > st.commit) & (mci_term == st.term)
+    ok = (eff_term > 0) & (mci > st.commit) & (mci_term == eff_term)
     return st._replace(commit=_where(ok, mci, st.commit))
 
 
@@ -630,6 +637,10 @@ def step(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     st = st._replace(ack_age=jnp.minimum(st.ack_age + 1, 1 << 20))
 
     st, hb_fire, vote_fire = _tick(st, cfg, active, tick)
+    # Leadership term entering the message phase: a leader demoted by a
+    # later same-round message keeps its right to commit acks it
+    # processed while leading (see _quorum_commit).
+    lead_term0 = _where(st.state == LEADER, st.term, 0)
 
     resp = jnp.zeros((st.term.shape[0], P, P, cfg.fields), jnp.int32)
     for q in range(P):  # unrolled: P is small and static
@@ -637,7 +648,7 @@ def step(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
         resp = resp.at[:, :, q, :].set(r)
 
     st = _apply_proposals(st, cfg, prop_count, prop_slot, active)
-    st = _quorum_commit(st, cfg, active)
+    st = _quorum_commit(st, cfg, active, lead_term0)
     st, outbox = _assemble_sends(st, cfg, resp, hb_fire, vote_fire, active)
     return st, outbox
 

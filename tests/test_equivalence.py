@@ -252,3 +252,17 @@ def test_full_equivalence_five_peers():
 def test_full_equivalence_heavy_loss():
     run_equivalence(seed=11, drop_p=0.45, delay_p=0.2, rounds=160,
                     partition_every=60)
+
+
+def test_full_equivalence_demoted_leader_commit():
+    """Regression (found by a 70-schedule soak): a leader that processes
+    an APP_RESP reaching quorum and a HIGHER-TERM vote in the same round
+    must still advance its commit — the reference's maybeCommit runs
+    per-response BEFORE the demotion; the kernel's deferred quorum phase
+    commits on behalf of the round-start leadership term."""
+    run_equivalence(seed=304, drop_p=0.45, delay_p=0.2, rounds=200,
+                    partition_every=60)
+
+
+def test_full_equivalence_seven_peers():
+    run_equivalence(seed=402, peers=7, groups=2, rounds=150, drop_p=0.25)
