@@ -1,14 +1,18 @@
 """Sharded-vs-unsharded kernel equivalence: the SAME randomized schedule
-stepped (a) on single-device arrays and (b) sharded over the 8-device
-("groups", "peers") mesh must produce bit-identical state every round —
-any divergence means the mesh layout or the routing collective changed
-semantics, not just placement.
+stepped (a) on single-device arrays and (b) through the ENGINE's exact
+compiled program — jit(step_routed) with pinned (state, mailbox)
+out_shardings over the 8-device mesh (engine.py builds the identical
+partial) — must produce bit-identical state every round. Any divergence
+means the mesh layout, the pinned-sharding constraints, or the fused
+routing collective changed semantics, not just placement.
 
 Complements tests/test_equivalence.py (kernel vs scalar oracle) and
 tests/test_multihost.py (multi-process execution); this one pins the
-single-process sharded path the engine serves from
-(tests/test_engine_sharded.py) against the reference arrays.
+single-process sharded serving path (tests/test_engine_sharded.py runs
+it end-to-end; here it is compared array-for-array against reference).
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -16,18 +20,24 @@ import pytest
 
 from etcd_tpu.ops import kernel
 from etcd_tpu.ops.state import GroupState, KernelConfig, init_state
-from etcd_tpu.parallel.mesh import make_mesh, mailbox_sharding, shard_state
+from etcd_tpu.parallel.mesh import (mailbox_sharding, make_mesh, shard_state,
+                                    state_sharding)
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
 
 
 @pytest.mark.parametrize("peers_axis", [1, 2], ids=["groups8", "g4xp2"])
-def test_sharded_step_is_bit_identical(peers_axis):
+def test_sharded_step_routed_is_bit_identical(peers_axis):
     G, P, W, E = 8, 4, 16, 3
     cfg = KernelConfig(groups=G, peers=P, window=W, max_ents=E)
     mesh = make_mesh(jax.devices()[:8], peers_axis=peers_axis)
     mb = mailbox_sharding(mesh)
+    # The engine's serving program, byte for byte (engine.py __init__).
+    step_sh = jax.jit(
+        functools.partial(kernel.step_routed.__wrapped__, cfg),
+        donate_argnums=(0, 1),
+        out_shardings=(state_sharding(mesh), mb))
 
     st_ref = init_state(cfg, stagger=True)
     st_sh = shard_state(init_state(cfg, stagger=True), mesh)
@@ -36,29 +46,26 @@ def test_sharded_step_is_bit_identical(peers_axis):
 
     rng = np.random.RandomState(9)
     for i in range(60):
-        # Random faults + proposals, applied identically to both sides.
-        drop = (rng.rand(G, P, P) < 0.25)[..., None].astype(np.int32)
-        drop = 1 - drop
-        pc = rng.randint(0, E + 1, G).astype(np.int32)
-        ps = rng.randint(0, P, G).astype(np.int32)
+        pc = jnp.asarray(rng.randint(0, E + 1, G).astype(np.int32))
+        ps = jnp.asarray(rng.randint(0, P, G).astype(np.int32))
 
-        st_ref, out_ref = kernel.step(cfg, st_ref,
-                                      inbox_ref * jnp.asarray(drop),
-                                      jnp.asarray(pc), jnp.asarray(ps),
-                                      jnp.asarray(True))
-        st_sh, out_sh = kernel.step(cfg, st_sh,
-                                    inbox_sh * jnp.asarray(drop),
-                                    jnp.asarray(pc), jnp.asarray(ps),
-                                    jnp.asarray(True))
+        st_ref, inbox_ref = kernel.step_routed(cfg, st_ref, inbox_ref,
+                                               pc, ps, jnp.asarray(True))
+        st_sh, inbox_sh = step_sh(st_sh, inbox_sh, pc, ps,
+                                  jnp.asarray(True))
+
         for name in GroupState._fields:
             a = np.asarray(getattr(st_ref, name))
             b = np.asarray(getattr(st_sh, name))
             assert (a == b).all(), f"round {i}: field {name} diverged"
-        a, b = np.asarray(out_ref), np.asarray(out_sh)
-        assert (a == b).all(), f"round {i}: outbox diverged"
+        a, b = np.asarray(inbox_ref), np.asarray(inbox_sh)
+        assert (a == b).all(), f"round {i}: routed inbox diverged"
 
-        inbox_ref = kernel.route_local(out_ref)
-        inbox_sh = jax.device_put(kernel.route_local(out_sh), mb)
+        # Random drops applied to the NEXT inbox — the engine's own
+        # fault-injection point (engine.drop_mask multiplies the routed
+        # inbox), identical on both sides.
+        drop = 1 - (rng.rand(G, P, P) < 0.25)[..., None].astype(np.int32)
+        inbox_ref = inbox_ref * jnp.asarray(drop)
+        inbox_sh = inbox_sh * jnp.asarray(drop)
 
-    # The schedule did real work on both sides.
     assert np.asarray(st_ref.commit).max() > 0
