@@ -64,15 +64,20 @@ def dense_to_message(fields, to_slot, frm_slot):
 
 
 class Mirror:
-    """G x P scalar Raft instances mirroring one kernel state."""
+    """G x P scalar Raft instances mirroring one kernel state.
 
-    def __init__(self, cfg: KernelConfig):
+    n_peers < cfg.peers exercises the kernel's padded peer slots: only
+    the first n_peers slots are live on both sides (the kernel's
+    peer_mask prefix; inactive slots must stay inert zeros)."""
+
+    def __init__(self, cfg: KernelConfig, n_peers=None):
         self.cfg = cfg
+        self.n_peers = cfg.peers if n_peers is None else n_peers
         self.rafts = {}
         for g in range(cfg.groups):
-            for p in range(cfg.peers):
+            for p in range(self.n_peers):
                 r = Raft(ScalarConfig(
-                    id=p + 1, peers=list(range(1, cfg.peers + 1)),
+                    id=p + 1, peers=list(range(1, self.n_peers + 1)),
                     election_tick=cfg.election_tick,
                     heartbeat_tick=cfg.heartbeat_tick,
                     storage=MemoryStorage(), group=g))
@@ -126,6 +131,12 @@ class Mirror:
         lead = np.asarray(st.lead)
         last = np.asarray(st.last_index)
         ring = np.asarray(st.log_term)
+        # Padded (inactive) slots must stay inert zeros on the kernel side.
+        if self.n_peers < cfg.peers:
+            for arr, nm in ((term, "term"), (state, "state"),
+                            (commit, "commit"), (last, "last")):
+                assert not arr[:, self.n_peers:].any(), (
+                    round_i, nm, "inactive slot moved")
         for (g, p), r in self.rafts.items():
             where = f"round {round_i} g={g} p={p}"
             assert term[g, p] == r.term, (where, "term", term[g, p], r.term)
@@ -156,16 +167,18 @@ class Mirror:
 def run_equivalence(seed, groups=5, peers=3, window=32, max_ents=3,
                     rounds=140, drop_p=0.2, delay_p=0.1, prop_p=0.6,
                     partition_every=45, partition_len=12,
-                    min_live_groups=None):
+                    min_live_groups=None, n_peers=None):
     """min_live_groups: the end-of-run liveness floor (how many groups
     must have committed something). Defaults to groups-1; harsher
     schedules (even peer counts where split votes need quorum n/2+1,
     heavy loss with few rounds) legitimately elect fewer — equivalence
-    is still asserted EVERY round regardless."""
+    is still asserted EVERY round regardless.
+    n_peers: live slots out of `peers` (padded-slot configs — the
+    engine's initial_peers shape)."""
     cfg = KernelConfig(groups=groups, peers=peers, window=window,
                        max_ents=max_ents)
-    st = init_state(cfg)
-    mirror = Mirror(cfg)
+    st = init_state(cfg, n_peers=n_peers)
+    mirror = Mirror(cfg, n_peers=n_peers)
     rng = np.random.RandomState(seed)
     G, P, F = groups, peers, cfg.fields
     inbox = np.zeros((G, P, P, F), np.int32)
@@ -223,6 +236,9 @@ def run_equivalence(seed, groups=5, peers=3, window=32, max_ents=3,
         lead_last = lastv[gidx, slots]
         lead_match = match[gidx, slots].copy()       # (G, P) targets
         lead_match[gidx, slots] = lead_last          # self counts as acked
+        if n_peers is not None and n_peers < peers:
+            # Padded slots never ack; they must not hold the throttle shut.
+            lead_match[:, n_peers:] = lead_last[:, None]
         worst_gap = lead_last - lead_match.min(axis=1)
         room_ok = worst_gap <= window - 4 * max_ents
         want = rng.rand(G) < prop_p
@@ -292,3 +308,10 @@ def test_full_equivalence_tight_window_pressure():
     flow control engage constantly."""
     run_equivalence(seed=600, window=16, max_ents=4, prop_p=0.95,
                     rounds=160)
+
+
+def test_full_equivalence_padded_slots():
+    """3 live slots in 5-wide padded arrays (the engine's initial_peers
+    shape): quorum arithmetic must ignore the padding and padded slots
+    must stay inert."""
+    run_equivalence(seed=900, peers=5, n_peers=3, groups=4, rounds=150)
