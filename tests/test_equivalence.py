@@ -83,7 +83,7 @@ class Mirror:
                     storage=MemoryStorage(), group=g))
                 self.rafts[(g, p)] = r
 
-    def run_round(self, inbox_np, prop_count, prop_slot):
+    def run_round(self, inbox_np, prop_count, prop_slot, tick=True):
         cfg = self.cfg
         # The kernel's admission throttle reads st.commit BEFORE its quorum
         # phase: a leader's commit never moves during the message phase
@@ -91,8 +91,9 @@ class Mirror:
         # equivalent scalar value is the round-start commit — the scalar
         # advances committed eagerly inside stepLeader instead.
         commit0 = {k: r.raft_log.committed for k, r in self.rafts.items()}
-        for r in self.rafts.values():
-            r.tick()
+        if tick:
+            for r in self.rafts.values():
+                r.tick()
         # Messages in kernel order: sender slot 0..P-1 across all instances.
         for q in range(cfg.peers):
             for (g, p), r in self.rafts.items():
@@ -167,14 +168,17 @@ class Mirror:
 def run_equivalence(seed, groups=5, peers=3, window=32, max_ents=3,
                     rounds=140, drop_p=0.2, delay_p=0.1, prop_p=0.6,
                     partition_every=45, partition_len=12,
-                    min_live_groups=None, n_peers=None):
+                    min_live_groups=None, n_peers=None, tick_p=1.0):
     """min_live_groups: the end-of-run liveness floor (how many groups
     must have committed something). Defaults to groups-1; harsher
     schedules (even peer counts where split votes need quorum n/2+1,
     heavy loss with few rounds) legitimately elect fewer — equivalence
     is still asserted EVERY round regardless.
     n_peers: live slots out of `peers` (padded-slot configs — the
-    engine's initial_peers shape)."""
+    engine's initial_peers shape).
+    tick_p: probability a round advances the logical clock — the
+    engine's ticks_per_round > 1 runs tick=False rounds (messages and
+    proposals still flow; timers freeze)."""
     cfg = KernelConfig(groups=groups, peers=peers, window=window,
                        max_ents=max_ents)
     st = init_state(cfg, n_peers=n_peers)
@@ -247,10 +251,14 @@ def run_equivalence(seed, groups=5, peers=3, window=32, max_ents=3,
         ps = np.where(has_lead, slots, 0).astype(np.int32)
 
         # -- the two sides step the SAME round ----------------------------
+        # The draw is skipped at tick_p=1.0 so legacy seeds keep their
+        # exact RNG streams (the pinned soak-found schedules depend on
+        # them).
+        tick = True if tick_p >= 1.0 else bool(rng.rand() < tick_p)
         st, outbox = kernel.step(cfg, st, jnp.asarray(faulted),
                                  jnp.asarray(pc), jnp.asarray(ps),
-                                 jnp.asarray(True))
-        mirror.run_round(faulted, pc, ps)
+                                 jnp.asarray(tick))
+        mirror.run_round(faulted, pc, ps, tick=tick)
 
         assert not np.asarray(st.need_host).any(), f"need_host at round {i}"
         mirror.assert_equal(st, i)
@@ -308,6 +316,12 @@ def test_full_equivalence_tight_window_pressure():
     flow control engage constantly."""
     run_equivalence(seed=600, window=16, max_ents=4, prop_p=0.95,
                     rounds=160)
+
+
+def test_full_equivalence_mixed_ticks():
+    """~40% tick=False rounds (ticks_per_round > 1 engine shape): timers
+    freeze but messages, proposals and commits keep flowing."""
+    run_equivalence(seed=1000, tick_p=0.6, rounds=220)
 
 
 def test_full_equivalence_padded_slots():
