@@ -142,12 +142,14 @@ class TenantAPI:
 class EngineHttp:
     """A listening HTTP front for a MultiEngine."""
 
-    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 cors=None, tls_context=None) -> None:
         self.engine = engine
         router = Router()
         self.api = TenantAPI(engine)
         self.api.install(router)
-        self.http = HttpServer(host, port, router)
+        self.http = HttpServer(host, port, router, cors=cors,
+                               tls_context=tls_context)
 
     @property
     def url(self) -> str:

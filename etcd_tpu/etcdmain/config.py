@@ -88,6 +88,11 @@ class MainConfig:
     peer_key_file: str = ""
     peer_ca_file: str = ""
     peer_client_cert_auth: bool = False
+    # Multi-tenant engine mode (the batched-kernel serving path).
+    engine_groups: int = 0
+    engine_peers: int = 5
+    engine_window: int = 32
+    engine_interval_ms: int = 1
 
     @property
     def is_proxy(self) -> bool:
@@ -100,6 +105,10 @@ class MainConfig:
     @property
     def should_fallback_to_proxy(self) -> bool:
         return self.discovery_fallback == FALLBACK_PROXY
+
+    @property
+    def is_engine(self) -> bool:
+        return self.engine_groups > 0
 
     @property
     def election_ticks(self) -> int:
@@ -160,6 +169,15 @@ _FLAGS = [
     ("peer-ca-file", str, "", "Path to the peer server TLS trusted CA file"),
     ("peer-client-cert-auth", bool, False,
      "Enable peer client cert authentication"),
+    # Multi-tenant engine mode (beyond the reference: the batched-kernel
+    # serving path, docs/deployment.md §2).
+    ("engine-groups", int, 0,
+     "Multi-tenant engine mode: serve N consensus groups (tenants) from "
+     "one batched kernel at /tenants/{g}/v2/keys (0 = off)"),
+    ("engine-peers", int, 5, "Peer slots per engine group"),
+    ("engine-window", int, 32, "On-device log ring length per engine slot"),
+    ("engine-interval-ms", int, 1,
+     "Milliseconds between engine rounds (0 = flat out)"),
 ]
 
 
@@ -235,10 +253,25 @@ def parse_args(argv: Sequence[str],
             "-initial-cluster, -discovery and -discovery-srv are mutually "
             "exclusive")
     if ("listen-client-urls" in set_flags and
-            "advertise-client-urls" not in set_flags and not cfg.is_proxy):
+            "advertise-client-urls" not in set_flags and not cfg.is_proxy
+            and not cfg.is_engine):
         raise ConfigError(
             "-advertise-client-urls is required when -listen-client-urls is "
             "set explicitly")
+    if cfg.is_engine and (cfg.is_proxy or cfg.discovery or
+                          cfg.discovery_srv):
+        raise ConfigError(
+            "-engine-groups is mutually exclusive with proxy and "
+            "discovery modes")
+    if cfg.engine_groups < 0:
+        raise ConfigError("-engine-groups must be >= 0")
+    if cfg.is_engine:
+        if cfg.engine_peers < 1:
+            raise ConfigError("-engine-peers must be >= 1")
+        if cfg.engine_window < 4:
+            raise ConfigError("-engine-window must be >= 4")
+        if cfg.engine_interval_ms < 0:
+            raise ConfigError("-engine-interval-ms must be >= 0")
     if 5 * cfg.heartbeat_interval > cfg.election_timeout:
         raise ConfigError(
             f"-election-timeout[{cfg.election_timeout}ms] should be at least "

@@ -26,21 +26,24 @@ from etcd_tpu.proxy import Director, ReverseProxy, fetch_cluster_urls, readonly
 
 log = logging.getLogger("etcdmain")
 
-DIR_MEMBER, DIR_PROXY, DIR_EMPTY = "member", "proxy", "empty"
+DIR_MEMBER, DIR_PROXY, DIR_ENGINE, DIR_EMPTY = ("member", "proxy",
+                                                "engine", "empty")
 
 
 def identify_data_dir(dir_: str) -> str:
-    """Which mode this data dir was used for (reference etcd.go:376-404)."""
+    """Which mode this data dir was used for (reference etcd.go:376-404;
+    engine/ is this framework's multi-tenant mode)."""
     try:
         names = os.listdir(dir_)
     except FileNotFoundError:
         return DIR_EMPTY
-    m = DIR_MEMBER in names
-    p = DIR_PROXY in names
-    if m and p:
+    present = [d for d in (DIR_MEMBER, DIR_PROXY, DIR_ENGINE)
+               if d in names]
+    if len(present) > 1:
         raise ConfigError(
-            "invalid datadir: both member and proxy directories exist")
-    return DIR_MEMBER if m else DIR_PROXY if p else DIR_EMPTY
+            f"invalid datadir: {' and '.join(present)} directories both "
+            "exist")
+    return present[0] if present else DIR_EMPTY
 
 
 def start_etcd(cfg: MainConfig) -> Etcd:
@@ -88,6 +91,50 @@ def start_etcd(cfg: MainConfig) -> Etcd:
     log.info("etcd-tpu member %s listening: client=%s peer=%s",
              cfg.name, e.client_urls, e.peer_urls)
     return e
+
+
+class EngineServer:
+    """Multi-tenant engine mode: G consensus groups served from one
+    batched kernel at /tenants/{g}/v2/keys (docs/deployment.md §2)."""
+
+    def __init__(self, cfg: MainConfig) -> None:
+        from etcd_tpu.etcdhttp.tenants import EngineHttp
+        from etcd_tpu.server.engine import EngineConfig, MultiEngine
+
+        self.engine = MultiEngine(EngineConfig(
+            groups=cfg.engine_groups, peers=cfg.engine_peers,
+            window=cfg.engine_window,
+            data_dir=os.path.join(cfg.data_dir, DIR_ENGINE),
+            round_interval=cfg.engine_interval_ms / 1000.0))
+        client_tls = TLSInfo(cert_file=cfg.cert_file, key_file=cfg.key_file,
+                             ca_file=cfg.ca_file,
+                             client_cert_auth=cfg.client_cert_auth)
+        self.http = []
+        from etcd_tpu.embed import _listen_addr
+        for url in cfg.listen_client_urls:
+            host, port = _listen_addr(url)
+            self.http.append(EngineHttp(
+                self.engine, host, port,
+                cors=set(cfg.cors) if cfg.cors else None,
+                tls_context=(client_tls.server_context()
+                             if not client_tls.empty() else None)))
+
+    @property
+    def client_urls(self):
+        return [h.url for h in self.http]
+
+    def start(self) -> None:
+        for h in self.http:
+            h.start()
+        self.engine.start()
+        log.info("engine: %d tenant groups x %d peers listening on %s",
+                 self.engine.cfg.groups, self.engine.cfg.peers,
+                 self.client_urls)
+
+    def stop(self) -> None:
+        self.engine.stop()
+        for h in self.http:
+            h.stop()
 
 
 class ProxyServer:
@@ -214,6 +261,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"cannot start as proxy: data dir {cfg.data_dir} was "
               f"previously initialized as a member", file=sys.stderr)
         return 1
+    if cfg.is_engine != (which == DIR_ENGINE) and which != DIR_EMPTY:
+        requested = ("engine" if cfg.is_engine
+                     else "proxy" if cfg.is_proxy else "member")
+        print(f"cannot start as {requested}: data dir {cfg.data_dir} was "
+              f"previously initialized as {which}", file=sys.stderr)
+        return 1
+
+    if cfg.is_engine:
+        runner = EngineServer(cfg)
+        runner.start()
+        try:
+            stop_ev.wait()
+        finally:
+            runner.stop()
+        return 0
 
     runner = None
     should_proxy = cfg.is_proxy or which == DIR_PROXY

@@ -129,6 +129,7 @@ class MultiEngine:
                 self.kcfg, st, inbox, pc, ps, t)
 
         self.wal = EngineWAL(cfg.data_dir, fsync=cfg.fsync)
+        self._check_geometry()
         self.wait = Wait()
         self.reqid = idutil.Generator(1)
         self._pending: List[deque] = [deque() for _ in range(G)]
@@ -173,6 +174,31 @@ class MultiEngine:
         # Chaos hook: (G, P_to, P_from, 1)-broadcastable 0/1 mask applied to
         # the routed inbox (tests inject drops/partitions here).
         self.drop_mask = None
+
+    def _check_geometry(self) -> None:
+        """Persist (groups, peers, window) beside the WAL and refuse a
+        restart with different values — the checkpoint/WAL arrays are
+        shaped by them, and restoring a (G,P)-shaped checkpoint into a
+        different-shaped state would crash at best and silently corrupt
+        consensus state at worst. (max_ents shapes only the mailbox, not
+        persisted state, so it may change.)"""
+        import os
+        path = os.path.join(self.cfg.data_dir, "geometry.json")
+        want = {"groups": self.cfg.groups, "peers": self.cfg.peers,
+                "window": self.cfg.window}
+        if os.path.exists(path):
+            with open(path) as f:
+                have = json.load(f)
+            if have != want:
+                raise ValueError(
+                    f"engine data dir {self.cfg.data_dir} was initialized "
+                    f"with geometry {have}, refusing to open with {want} — "
+                    "move the data dir aside or match the flags")
+        else:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(want, f)
+            os.replace(tmp, path)
 
     def _dev(self, name: str, arr) -> Any:
         """Host array -> device, on the field's canonical sharding when a

@@ -241,3 +241,91 @@ def test_proxy_fails_over_dead_endpoint(one_member, tmp_path):
     finally:
         d.stop()
         h.stop()
+
+
+# -- engine mode --------------------------------------------------------------
+
+def test_engine_flags_validation():
+    with pytest.raises(ConfigError):
+        parse_args(["--engine-groups", "4", "--proxy", "on"])
+    with pytest.raises(ConfigError):
+        parse_args(["--engine-groups", "4", "--discovery", "http://x"])
+    cfg = parse_args(["--engine-groups", "8", "--engine-peers", "3",
+                      "--listen-client-urls", "http://127.0.0.1:0"])
+    assert cfg.is_engine and cfg.engine_groups == 8 and cfg.engine_peers == 3
+
+
+def test_engine_mode_serves_and_restarts(tmp_path):
+    """The CLI engine mode end-to-end in process: tenants served over
+    HTTP, data dir identified as engine/, restart keeps data."""
+    import json as _json
+    import urllib.request
+
+    from etcd_tpu.etcdmain.etcd import DIR_ENGINE, EngineServer
+
+    def put(base, g, key, val):
+        r = urllib.request.Request(
+            f"{base}/tenants/{g}/v2/keys/{key}",
+            data=f"value={val}".encode(), method="PUT",
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, _json.loads(resp.read())
+
+    cfg = MainConfig()
+    cfg.data_dir = str(tmp_path / "eng")
+    cfg.engine_groups, cfg.engine_peers = 4, 3
+    cfg.engine_interval_ms = 1
+    cfg.listen_client_urls = ("http://127.0.0.1:0",)
+    s = EngineServer(cfg)
+    s.start()
+    try:
+        assert s.engine.wait_leaders(60.0)
+        base = s.client_urls[0]
+        st, b = put(base, 2, "cli", "fromflags")
+        assert st == 201 and b["node"]["value"] == "fromflags"
+    finally:
+        s.stop()
+    assert identify_data_dir(cfg.data_dir) == DIR_ENGINE
+
+    s2 = EngineServer(cfg)
+    s2.start()
+    try:
+        base = s2.client_urls[0]
+        with urllib.request.urlopen(f"{base}/tenants/2/v2/keys/cli",
+                                    timeout=30) as resp:
+            b = _json.loads(resp.read())
+        assert b["node"]["value"] == "fromflags"
+    finally:
+        s2.stop()
+
+
+def test_engine_mode_refuses_member_dir(tmp_path):
+    from etcd_tpu.etcdmain.etcd import main as etcd_main
+    d = tmp_path / "was-member"
+    (d / "member").mkdir(parents=True)
+    rc = etcd_main(["--engine-groups", "2", "--data-dir", str(d)])
+    assert rc == 1
+
+
+def test_engine_flag_ranges():
+    for bad in (["--engine-groups", "-1"],
+                ["--engine-groups", "4", "--engine-peers", "0"],
+                ["--engine-groups", "4", "--engine-window", "2"],
+                ["--engine-groups", "4", "--engine-interval-ms", "-1"]):
+        with pytest.raises(ConfigError):
+            parse_args(bad)
+
+
+def test_engine_geometry_mismatch_refused(tmp_path):
+    from etcd_tpu.server.engine import EngineConfig, MultiEngine
+    d = str(tmp_path / "geo")
+    eng = MultiEngine(EngineConfig(groups=4, peers=3, window=16,
+                                   data_dir=d, fsync=False))
+    eng.stop()
+    with pytest.raises(ValueError, match="geometry"):
+        MultiEngine(EngineConfig(groups=8, peers=3, window=16,
+                                 data_dir=d, fsync=False))
+    # Same geometry reopens fine.
+    eng2 = MultiEngine(EngineConfig(groups=4, peers=3, window=16,
+                                    data_dir=d, fsync=False))
+    eng2.stop()

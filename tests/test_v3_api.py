@@ -46,11 +46,21 @@ def cluster(tmp_path_factory):
         m.stop()
 
 
-def v3(cluster, path, body, member=0):
+def v3(cluster, path, body, member=0, timeout=15.0):
+    """POST a v3 op; retries 5xx (election windows under load time
+    consensus ops out — real etcd clients loop on ErrNoLeader the same
+    way). 4xx answers are semantic and return immediately."""
+    import time
+
     base = cluster[member].client_urls[0]
-    return req("POST", base + "/v3/kv/" + path,
-               json.dumps(body).encode(),
-               {"Content-Type": "application/json"})
+    deadline = time.time() + timeout
+    while True:
+        st, hd, b = req("POST", base + "/v3/kv/" + path,
+                        json.dumps(body).encode(),
+                        {"Content-Type": "application/json"})
+        if st < 500 or time.time() >= deadline:
+            return st, hd, b
+        time.sleep(0.3)
 
 
 def test_put_range_roundtrip(cluster):
