@@ -667,19 +667,27 @@ class MultiEngine:
             g = int(g)
             s, lo, hi = self._committed_span(g)
             for i in range(lo + 1, hi + 1):
+                t = 0
                 if i > self.h_last[g, s] - W:
                     t = int(self.h_ring[g, s, i % W])
-                elif hist is not None:
-                    t = hist.get((g, i))
-                    if t is None:
-                        log.error("engine: no term for committed entry "
-                                  "g=%d i=%d during restore", g, i)
-                        continue
-                else:
-                    # Live path: unreachable (admission throttle bounds the
-                    # span within the ring); refusing beats misapplying.
-                    log.error("engine: apply index %d below ring window of "
-                              "g=%d slot=%d (last=%d)", i, g, s,
+                if t == 0 and hist is not None:
+                    # Restore path: the span slot's ring can hold the 0
+                    # sentinel INSIDE the window — a slot removed and
+                    # later re-added had its ring zeroed at the join, so
+                    # indices below its join point are unresolvable from
+                    # it even though other slots know them. hist (built
+                    # from every slot's replayed log history) supplies
+                    # the committed term; without this fallback those
+                    # entries would silently apply as leader no-ops and
+                    # ACKED WRITES WOULD VANISH on restart (soak-found).
+                    t = hist.get((g, i), 0)
+                if t == 0:
+                    # Live path: unreachable (applies are incremental, so
+                    # the span never reaches below a re-added slot's join
+                    # point or the ring window); refusing beats
+                    # misapplying.
+                    log.error("engine: no term for committed entry g=%d "
+                              "i=%d (slot=%d last=%d)", g, i, s,
                               self.h_last[g, s])
                     continue
                 payload = self.payloads.get((g, i, t))

@@ -37,6 +37,30 @@ def run_until(eng, pred, max_rounds=400, msg="condition"):
     raise AssertionError(f"{msg} not reached in {max_rounds} rounds")
 
 
+def drive_conf(eng, g, op, slot, max_rounds=600, timeout=30.0):
+    """Propose a conf change from a side thread while driving rounds;
+    returns the new slot list (asserts the change settled)."""
+    res = {}
+
+    def work():
+        try:
+            res["res"] = eng.conf_change(g, op, slot, timeout=timeout)
+        except Exception as e:  # pragma: no cover - surfaced by caller
+            res["err"] = e
+
+    th = threading.Thread(target=work, daemon=True)
+    th.start()
+    for _ in range(max_rounds):
+        if not th.is_alive():
+            break
+        eng.run_round()
+        th.join(timeout=0.001)
+    th.join(1.0)
+    assert "err" not in res, res.get("err")
+    assert "res" in res, f"conf {op} slot {slot} never settled"
+    return res["res"]
+
+
 def put_async(eng, g, key, val):
     """Issue a blocking do() from a side thread so the test thread can keep
     driving rounds deterministically."""
@@ -248,6 +272,54 @@ def test_engine_snapshot_install_catches_up_partitioned_follower(tmp_path):
     settle(eng, t, out, max_rounds=800)
     assert eng.do(0, Request(method="GET", path="/healed")).node.value == "ok"
     eng.stop()
+
+
+def test_engine_restart_after_slot_readd_keeps_writes(tmp_path):
+    """Soak-found durability bug: remove slot 0, re-add it, write, then
+    restart. Restore picks the committed-span slot by argmax(commit) —
+    a tie lands on slot 0, whose ring was zeroed below its re-join point,
+    so pre-fix the replay resolved those committed entries to term 0 and
+    silently dropped them as leader no-ops (ACKED WRITES VANISHED)."""
+    d = tmp_path / "readd"
+
+    def mk():
+        return MultiEngine(make_cfg(d, groups=1, peers=5, initial_peers=3))
+
+    eng = mk()
+    run_until(eng, lambda: eng.leader_slot(0) >= 0, msg="leader")
+    keys = []
+    for i in range(3):
+        t, out = put_async(eng, 0, f"/pre{i}", "v")
+        settle(eng, t, out)
+        keys.append(f"/pre{i}")
+    assert 0 not in drive_conf(eng, 0, "remove", 0)
+    run_until(eng, lambda: eng.leader_slot(0) >= 0, max_rounds=800,
+              msg="re-election")
+    for i in range(3):
+        t, out = put_async(eng, 0, f"/mid{i}", "v")
+        settle(eng, t, out, max_rounds=800)
+        keys.append(f"/mid{i}")
+    assert 0 in drive_conf(eng, 0, "add", 0)
+    for i in range(3):
+        t, out = put_async(eng, 0, f"/post{i}", "v")
+        settle(eng, t, out, max_rounds=800)
+        keys.append(f"/post{i}")
+    # The re-added slot must fully catch up: restore picks the span slot
+    # by argmax(commit), and the tie lands on slot 0 — the poisoned-ring
+    # slot — only once its commit matches the max (the soak's heal
+    # window did this implicitly; without it the test passes on broken
+    # code).
+    run_until(eng,
+              lambda: (eng.h_commit[0, 0] == eng.h_commit[0].max()
+                       and eng.h_commit[0, 0] > 0),
+              max_rounds=800, msg="re-added slot catch-up")
+    eng.stop()
+
+    eng2 = mk()
+    lost = [k for k in keys
+            if eng2.do(0, Request(method="GET", path=k)).node.value != "v"]
+    assert not lost, f"acked writes lost after slot re-add restart: {lost}"
+    eng2.stop()
 
 
 def test_engine_watch_fires_on_apply(tmp_path):
