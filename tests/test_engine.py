@@ -61,6 +61,24 @@ def drive_conf(eng, g, op, slot, max_rounds=600, timeout=30.0):
     return res["res"]
 
 
+def partition_mask(G, P, rng, prob=0.4):
+    """Random drop mask: fully partition one random slot in ~prob of the
+    groups; returns the (G, P, P, 1)-broadcastable multiplier for
+    eng.drop_mask (or None when nothing got partitioned)."""
+    import jax.numpy as jnp
+
+    m_to = np.ones((G, P, 1, 1), np.int32)
+    m_from = np.ones((G, 1, P, 1), np.int32)
+    any_cut = False
+    for g in range(G):
+        if rng.rand() < prob:
+            s = rng.randint(P)
+            m_to[g, s] = 0
+            m_from[g, 0, s] = 0
+            any_cut = True
+    return jnp.asarray(m_to * m_from) if any_cut else None
+
+
 def put_async(eng, g, key, val):
     """Issue a blocking do() from a side thread so the test thread can keep
     driving rounds deterministically."""
@@ -283,7 +301,8 @@ def test_engine_restart_after_slot_readd_keeps_writes(tmp_path):
     d = tmp_path / "readd"
 
     def mk():
-        return MultiEngine(make_cfg(d, groups=1, peers=5, initial_peers=3))
+        # Module-standard shape; only group 0 is exercised.
+        return MultiEngine(make_cfg(d, initial_peers=3))
 
     eng = mk()
     run_until(eng, lambda: eng.leader_slot(0) >= 0, msg="leader")
@@ -435,15 +454,8 @@ def test_engine_chaos_soak_acked_writes_survive(tmp_path):
                 epoch["n"] += 1
                 # Random partition: one random slot in ~half the groups
                 # (never enough to kill quorum everywhere for long).
-                G, P = eng.cfg.groups, eng.cfg.peers
-                m_to = np.ones((G, P, 1, 1), np.int32)
-                m_from = np.ones((G, 1, P, 1), np.int32)
-                for g in range(G):
-                    if rng.rand() < 0.5:
-                        s = rng.randint(P)
-                        m_to[g, s] = 0
-                        m_from[g, 0, s] = 0
-                eng.drop_mask = jnp.asarray(m_to * m_from)
+                eng.drop_mask = partition_mask(eng.cfg.groups,
+                                               eng.cfg.peers, rng, prob=0.5)
 
                 outs = []
                 for w in range(6):
@@ -487,3 +499,72 @@ def _has_key(eng, g, key):
         return eng.do(g, Request(method="GET", path=key)).node.value == "v"
     except errors.EtcdError:
         return False
+
+
+def test_engine_chaos_soak_membership_churn(tmp_path):
+    """Chaos soak variant with MEMBERSHIP churn: random add/remove through
+    consensus interleaved with partitions, writes and crash-restarts; all
+    acked writes must survive (this schedule class found the slot-re-add
+    restore bug the dedicated regression test pins)."""
+    d = tmp_path / "confsoak"
+    rng = np.random.RandomState(17)
+    acked = {}
+
+    def mk():
+        # Module-standard shape (one shared XLA compile; see make_cfg).
+        return MultiEngine(make_cfg(d, request_timeout=60.0,
+                                    initial_peers=3))
+
+    eng = mk()
+    try:
+        NG = eng.cfg.groups
+        run_until(eng, lambda: all(eng.leader_slot(g) >= 0
+                                   for g in range(NG)), msg="leaders")
+        for restart in range(2):
+            for ep in range(3):
+                g = rng.randint(NG)
+                active = list(np.nonzero(eng.h_mask[g])[0])
+                grow = (len(active) <= 2
+                        or (len(active) < 5 and rng.rand() < 0.5))
+                if grow:
+                    free = [s for s in range(5) if s not in active]
+                    drive_conf(eng, g, "add", int(rng.choice(free)))
+                else:
+                    drive_conf(eng, g, "remove", int(rng.choice(active)))
+
+                eng.drop_mask = partition_mask(NG, eng.cfg.peers, rng)
+                outs = []
+                for w in range(4):
+                    gg = rng.randint(NG)
+                    key = f"/churn/{restart}_{ep}_{w}"
+                    t, out = put_async(eng, gg, key, "v")
+                    outs.append((t, out, key, gg))
+                for t, out, key, gg in outs:
+                    try:
+                        settle(eng, t, out, max_rounds=800)
+                    except (AssertionError, errors.EtcdError):
+                        continue
+                    acked[key] = gg
+                eng.drop_mask = None
+                for _ in range(10):
+                    eng.run_round()
+            eng.stop()
+            if restart < 1:
+                eng = mk()
+                run_until(eng, lambda: all(eng.leader_slot(g) >= 0
+                                           for g in range(NG)),
+                          max_rounds=900, msg="post-restart leaders")
+
+        eng2 = mk()
+        try:
+            assert len(acked) >= 12, f"too few acked writes: {len(acked)}"
+            lost = [k for k, gg in acked.items()
+                    if not _has_key(eng2, gg, k)]
+            assert not lost, f"acked writes lost: {lost[:5]}"
+        finally:
+            eng2.stop()
+    finally:
+        try:
+            eng.stop()
+        except Exception:
+            pass
