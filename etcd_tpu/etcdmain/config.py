@@ -93,6 +93,10 @@ class MainConfig:
     engine_peers: int = 5
     engine_window: int = 32
     engine_interval_ms: int = 1
+    # 0 = single-device arrays; >0 = shard the kernel over a
+    # ("groups", "peers") mesh of all visible devices, with this many on
+    # the peers axis (1 = all devices on the groups axis).
+    engine_mesh_peers_axis: int = 0
 
     @property
     def is_proxy(self) -> bool:
@@ -178,6 +182,9 @@ _FLAGS = [
     ("engine-window", int, 32, "On-device log ring length per engine slot"),
     ("engine-interval-ms", int, 1,
      "Milliseconds between engine rounds (0 = flat out)"),
+    ("engine-mesh-peers-axis", int, 0,
+     "Shard the engine over all visible devices: mesh peers-axis size "
+     "(0 = no mesh, 1 = all devices on the groups axis)"),
 ]
 
 
@@ -272,6 +279,8 @@ def parse_args(argv: Sequence[str],
             raise ConfigError("-engine-window must be >= 4")
         if cfg.engine_interval_ms < 0:
             raise ConfigError("-engine-interval-ms must be >= 0")
+        if cfg.engine_mesh_peers_axis < 0:
+            raise ConfigError("-engine-mesh-peers-axis must be >= 0")
     if 5 * cfg.heartbeat_interval > cfg.election_timeout:
         raise ConfigError(
             f"-election-timeout[{cfg.election_timeout}ms] should be at least "

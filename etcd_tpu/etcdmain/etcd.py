@@ -101,11 +101,36 @@ class EngineServer:
         from etcd_tpu.etcdhttp.tenants import EngineHttp
         from etcd_tpu.server.engine import EngineConfig, MultiEngine
 
+        mesh = None
+        if cfg.engine_mesh_peers_axis > 0:
+            import jax
+            from etcd_tpu.parallel.mesh import make_mesh
+            n = len(jax.devices())
+            pa = cfg.engine_mesh_peers_axis
+            # Fail with a flag-level message, not an opaque sharding error
+            # from deep inside device placement.
+            if n % pa != 0:
+                raise ConfigError(
+                    f"-engine-mesh-peers-axis {pa} does not divide the "
+                    f"{n} visible devices")
+            if cfg.engine_peers % pa != 0:
+                raise ConfigError(
+                    f"-engine-peers {cfg.engine_peers} must be divisible "
+                    f"by -engine-mesh-peers-axis {pa}")
+            if cfg.engine_groups % (n // pa) != 0:
+                raise ConfigError(
+                    f"-engine-groups {cfg.engine_groups} must be "
+                    f"divisible by the groups mesh axis ({n // pa} = "
+                    f"{n} devices / peers-axis {pa})")
+            mesh = make_mesh(jax.devices(), peers_axis=pa)
+            log.info("engine: sharding over mesh %s",
+                     dict(zip(mesh.axis_names, mesh.devices.shape)))
         self.engine = MultiEngine(EngineConfig(
             groups=cfg.engine_groups, peers=cfg.engine_peers,
             window=cfg.engine_window,
             data_dir=os.path.join(cfg.data_dir, DIR_ENGINE),
-            round_interval=cfg.engine_interval_ms / 1000.0))
+            round_interval=cfg.engine_interval_ms / 1000.0,
+            mesh=mesh))
         client_tls = TLSInfo(cert_file=cfg.cert_file, key_file=cfg.key_file,
                              ca_file=cfg.ca_file,
                              client_cert_auth=cfg.client_cert_auth)
@@ -269,7 +294,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 1
 
     if cfg.is_engine:
-        runner = EngineServer(cfg)
+        try:
+            runner = EngineServer(cfg)
+        except (ConfigError, ValueError) as e:
+            # Flag/geometry-level refusals answer like other config
+            # errors, not with a traceback.
+            print(str(e), file=sys.stderr)
+            return 1
         runner.start()
         try:
             stop_ev.wait()

@@ -21,6 +21,8 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    import threading
+
     from etcd_tpu.ops.pallas_kernels import ring_resolve
     from etcd_tpu.utils.platform import enable_compile_cache, force_cpu
 
@@ -28,6 +30,23 @@ def main() -> int:
         # The image preloads jax; the env var alone is too late
         # (utils/platform.py docstring) — force through jax.config.
         force_cpu(1)
+    else:
+        # Ambient backend init can hang forever (tunneled TPU; the same
+        # hazard bench.py watchdogs) — bail to a clear message instead.
+        up = threading.Event()
+
+        def _bail():
+            if not up.is_set():
+                print("backend init stalled >75s (TPU tunnel down?); "
+                      "re-run with JAX_PLATFORMS=cpu", file=sys.stderr)
+                os._exit(7)
+
+        t = threading.Timer(75.0, _bail)
+        t.daemon = True
+        t.start()
+        jax.devices()
+        up.set()
+        t.cancel()
     enable_compile_cache()
     G = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     P = int(sys.argv[2]) if len(sys.argv) > 2 else 5

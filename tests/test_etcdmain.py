@@ -329,3 +329,52 @@ def test_engine_geometry_mismatch_refused(tmp_path):
     eng2 = MultiEngine(EngineConfig(groups=4, peers=3, window=16,
                                     data_dir=d, fsync=False))
     eng2.stop()
+
+
+def test_engine_mesh_flag_serves(tmp_path):
+    """--engine-mesh-peers-axis shards the CLI engine over all visible
+    devices (the 8-device CPU mesh under conftest) and still serves."""
+    import json as _json
+    import urllib.request
+
+    from etcd_tpu.etcdmain.etcd import EngineServer
+
+    cfg = MainConfig()
+    cfg.data_dir = str(tmp_path / "mesheng")
+    cfg.engine_groups, cfg.engine_peers = 8, 4
+    cfg.engine_interval_ms = 1
+    cfg.engine_mesh_peers_axis = 2
+    cfg.listen_client_urls = ("http://127.0.0.1:0",)
+    s = EngineServer(cfg)
+    s.start()
+    try:
+        assert s.engine.cfg.mesh is not None
+        assert len(s.engine.st.term.devices()) == 8
+        assert s.engine.wait_leaders(60.0)
+        base = s.client_urls[0]
+        r = urllib.request.Request(
+            f"{base}/tenants/1/v2/keys/meshflag", data=b"value=on",
+            method="PUT",
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            assert resp.status == 201
+            assert _json.loads(resp.read())["node"]["value"] == "on"
+    finally:
+        s.stop()
+
+
+def test_engine_mesh_divisibility_errors(tmp_path):
+    from etcd_tpu.etcdmain.etcd import EngineServer, main as etcd_main
+
+    cfg = MainConfig()
+    cfg.data_dir = str(tmp_path / "bad")
+    cfg.engine_groups, cfg.engine_peers = 5, 4   # 5 % 8 != 0
+    cfg.engine_mesh_peers_axis = 1
+    cfg.listen_client_urls = ("http://127.0.0.1:0",)
+    with pytest.raises(ConfigError, match="divisible"):
+        EngineServer(cfg)
+    # And via main(): clean exit code, no traceback.
+    rc = etcd_main(["--engine-groups", "5", "--engine-peers", "4",
+                    "--engine-mesh-peers-axis", "1",
+                    "--data-dir", str(tmp_path / "bad2")])
+    assert rc == 1
