@@ -47,9 +47,14 @@ from etcd_tpu.ops.state import (CANDIDATE, FOLLOWER, F_COMMIT, F_HINT,
                                 F_TYPE, GroupState, KernelConfig, LEADER,
                                 M_APP, M_APP_RESP, M_HB, M_HB_RESP, M_NONE,
                                 M_VOTE, M_VOTE_RESP, N_FIXED_FIELDS,
-                                PR_PROBE, PR_REPLICATE, active_mask,
-                                in_window, quorum, ring_lookup, term_at,
-                                xorshift32)
+                                NH_SNAP, NH_VIOLATION, PR_PROBE, PR_REPLICATE,
+                                active_mask, in_window, quorum, ring_lookup,
+                                term_at, xorshift32)
+
+
+def _flag(need_host: jax.Array, mask: jax.Array, bit: int) -> jax.Array:
+    """OR an NH_* bit into the (G, P) need_host bitmask where mask holds."""
+    return need_host | jnp.where(mask, jnp.int32(bit), 0)
 
 
 def _where(m, a, b):
@@ -266,7 +271,7 @@ def _step_msgs_from(st: GroupState, cfg: KernelConfig, q: int,
     prev_in_win = in_window(st, cfg, mindex)
     # Below the device window (but >= commit): the host resolves it.
     escape = chk & ~prev_in_win & (mindex <= st.last_index)
-    st = st._replace(need_host=st.need_host | escape)
+    st = st._replace(need_host=_flag(st.need_host, escape, NH_SNAP))
 
     match_ok = chk & ~escape & prev_in_win & (prev_t == mlogterm)
     rej = chk & ~escape & ~match_ok
@@ -284,8 +289,11 @@ def _step_msgs_from(st: GroupState, cfg: KernelConfig, q: int,
     first_j = jnp.argmax(mismatch, axis=-1)
     ci = _where(any_conf, mindex + 1 + first_j, 0)
     # Safety: conflicting with a committed entry is a protocol violation
-    # (reference log.go maybeAppend panic); route to host for diagnosis.
-    st = st._replace(need_host=st.need_host | (any_conf & (ci <= st.commit)))
+    # (reference log.go maybeAppend panic); flag it distinctly so the host
+    # dumps state and fails loudly instead of papering over it.
+    st = st._replace(need_host=_flag(st.need_host,
+                                     any_conf & (ci <= st.commit),
+                                     NH_VIOLATION))
 
     do_append = any_conf
     st = _write_terms(st, cfg, anchor=mindex, terms=ent_terms, lo=ci,
@@ -545,7 +553,8 @@ def _assemble_sends(st: GroupState, cfg: KernelConfig, resp: jax.Array,
     sendable = prev_in_win & ents_ok
     # Target lags below the device window -> host must ship a snapshot.
     need_snap = is_ldr & tgt_ok & has_gap & ~sendable
-    st = st._replace(need_host=st.need_host | jnp.any(need_snap, axis=2))
+    st = st._replace(need_host=_flag(st.need_host,
+                                     jnp.any(need_snap, axis=2), NH_SNAP))
 
     send_app = is_ldr & tgt_ok & has_gap & ~paused_eff & sendable
     n = jnp.minimum(last - st.next + 1, E)
@@ -658,6 +667,13 @@ def step(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     st = _apply_proposals(st, cfg, prop_count, prop_slot, active)
     st = _quorum_commit(st, cfg, active, lead_term0)
     st, outbox = _assemble_sends(st, cfg, resp, hb_fire, vote_fire, active)
+    # Defensive invariant detector (the reference's log.maybeAppend /
+    # commitTo panics): a commit cursor past the log end can only mean
+    # corrupted state — no legal transition produces it. Like the
+    # conflict-at/below-commit flag above, this is a NH_VIOLATION the host
+    # must treat as fatal, not a serviceable escape.
+    bad = active & (st.commit > st.last_index)
+    st = st._replace(need_host=_flag(st.need_host, bad, NH_VIOLATION))
     return st, outbox
 
 

@@ -37,6 +37,13 @@ PR_PROBE, PR_REPLICATE = 0, 1
 # Kernel message types (dense codes; NONE=0 means empty slot).
 M_NONE, M_APP, M_APP_RESP, M_VOTE, M_VOTE_RESP, M_HB, M_HB_RESP = range(7)
 
+# need_host bitmask values (see GroupState.need_host).
+NH_SNAP = 1        # lagging peer: entries fell below the device ring window;
+                   # host must ship a snapshot / resolve the append
+NH_VIOLATION = 2   # conflict at/below commit: a PROTOCOL VIOLATION (the
+                   # reference panics in log.maybeAppend) — the host engine
+                   # must dump state and fail loudly, never paper over it
+
 # Message field offsets in the last axis of inbox/outbox arrays.
 F_TYPE, F_TERM, F_INDEX, F_LOGTERM, F_COMMIT, F_REJECT, F_HINT, F_NENT = range(8)
 N_FIXED_FIELDS = 8
@@ -109,9 +116,10 @@ class GroupState(NamedTuple):
     # RemoveGroup + raft.go:709-744 addNode/removeNode).
     peer_mask: jax.Array     # (G, P) bool
 
-    # Host-escape flags: group needs the scalar slow path (snapshot send,
-    # append below the device window, safety check failure).
-    need_host: jax.Array     # (G, P) bool
+    # Host-escape flags: NH_* bitmask — why this instance needs the host
+    # slow path (snapshot send, append below the device window) or, worse,
+    # detected a safety violation (NH_VIOLATION).
+    need_host: jax.Array     # (G, P) int32 bitmask of NH_*
 
 
 def _seed(groups: int, peers: int) -> np.ndarray:
@@ -174,7 +182,7 @@ def init_state(cfg: KernelConfig, n_peers=None,
         ack_age=zeros_gpp(),
         votes=zeros_gpp(),
         peer_mask=jnp.asarray(mask0),
-        need_host=jnp.zeros((G, P), bool),
+        need_host=jnp.zeros((G, P), jnp.int32),
     )
 
 

@@ -66,6 +66,14 @@ P_CONF = 0x01   # membership change (JSON {"id", "op", "slot"})
 _LEADER = 2  # ops.state.LEADER (kept in sync; imported lazily with jax)
 
 
+class EngineViolation(RuntimeError):
+    """A consensus safety violation detected by the kernel (NH_VIOLATION:
+    an append conflicted with a committed entry — the condition the
+    reference panics on in log.maybeAppend). The engine dumps the affected
+    groups' state and refuses to continue; state after this point cannot
+    be trusted."""
+
+
 @dataclass
 class EngineConfig:
     groups: int
@@ -128,8 +136,10 @@ class MultiEngine:
             self._step_fn = lambda st, inbox, pc, ps, t: kernel.step_routed(
                 self.kcfg, st, inbox, pc, ps, t)
 
-        self.wal = EngineWAL(cfg.data_dir, fsync=cfg.fsync)
+        # Geometry guard BEFORE anything touches the data dir: a mismatch
+        # must refuse the dir before the WAL opens/creates any file in it.
         self._check_geometry()
+        self.wal = EngineWAL(cfg.data_dir, fsync=cfg.fsync)
         self.wait = Wait()
         self.reqid = idutil.Generator(1)
         self._pending: List[deque] = [deque() for _ in range(G)]
@@ -142,6 +152,9 @@ class MultiEngine:
         self._thread: Optional[threading.Thread] = None
         self.round_no = 0
         self.round_ms_ewma = 0.0   # smoothed wall time per round
+        # Last few durable round records, kept for the violation dump.
+        self._recent_recs: deque = deque(maxlen=8)
+        self.failed: Optional[Exception] = None
 
         # Host mirrors of the last read-back device state.
         self.h_term = np.zeros((G, P), np.int32)
@@ -183,6 +196,8 @@ class MultiEngine:
         consensus state at worst. (max_ents shapes only the mailbox, not
         persisted state, so it may change.)"""
         import os
+        from etcd_tpu.utils.fileutil import touch_dir_all
+        touch_dir_all(self.cfg.data_dir)
         path = os.path.join(self.cfg.data_dir, "geometry.json")
         want = {"groups": self.cfg.groups, "peers": self.cfg.peers,
                 "window": self.cfg.window}
@@ -488,10 +503,15 @@ class MultiEngine:
     # ------------------------------------------------------------------
 
     def _run(self) -> None:
-        while not self._stop_ev.is_set():
-            self.run_round()
-            if self.cfg.round_interval:
-                time.sleep(self.cfg.round_interval)
+        try:
+            while not self._stop_ev.is_set():
+                self.run_round()
+                if self.cfg.round_interval:
+                    time.sleep(self.cfg.round_interval)
+        except Exception as e:  # noqa: BLE001 — record, then re-raise
+            self.failed = e
+            self._stop_ev.set()
+            raise
 
     def run_round(self) -> None:
         """One engine round. Callable directly (tests drive the engine
@@ -544,6 +564,15 @@ class MultiEngine:
             np.array(a) for a in
             self._jax.device_get((st.term, st.vote, st.commit, st.state,
                                   st.last_index, st.log_term, st.need_host)))
+
+        # Violation check FIRST — before this round's WAL append, applies,
+        # or acks: a flagged round's commits come from state the kernel
+        # just classified as untrustworthy, and must never reach clients.
+        if need_host.any():
+            from etcd_tpu.ops.state import NH_VIOLATION
+            viol = (need_host & NH_VIOLATION) != 0
+            if viol.any():
+                self._fail_violation(viol)
 
         # -- 4. durable round record --------------------------------------
         rec = RoundRecord(round_no=self.round_no)
@@ -604,9 +633,11 @@ class MultiEngine:
         rec.confs.extend(self._collect_committed_confs())
         if not rec.is_empty():
             self.wal.append(rec)
+            self._recent_recs.append(rec)
         self._apply_committed(trigger=True)
 
-        # -- 7. need_host: snapshot-install lagging followers -------------
+        # -- 7. need_host: snapshot-install lagging followers (violations
+        # already failed the round before the WAL append above).
         if need_host.any():
             self._service_need_host(need_host)
 
@@ -811,6 +842,53 @@ class MultiEngine:
                                   state=self._dev("state", stat),
                                   lead=self._dev("lead", lead))
             self.h_state[g, slot] = 0
+
+    def _fail_violation(self, viol: np.ndarray) -> None:
+        """NH_VIOLATION is a protocol-violation DETECTOR (an append
+        conflicted at/below a committed index — reference log.go
+        maybeAppend panics on this). Dump the flagged groups' full device
+        state plus the recent WAL rounds for offline diagnosis, then
+        refuse to continue: papering over it would let diverged state
+        serve reads as if committed."""
+        import os
+        flagged = [int(g) for g in np.nonzero(viol.any(axis=1))[0]]
+        arrays = self._jax.device_get({
+            "term": self.st.term, "vote": self.st.vote,
+            "commit": self.st.commit, "lead": self.st.lead,
+            "state": self.st.state, "last_index": self.st.last_index,
+            "log_term": self.st.log_term, "match": self.st.match,
+            "next": self.st.next, "pr_state": self.st.pr_state,
+            "need_host": self.st.need_host})
+        dump = {
+            "round": self.round_no,
+            "flagged": {str(g): {
+                "slots": [int(p) for p in np.nonzero(viol[g])[0]],
+                "applied": int(self.applied[g]),
+                "mask": np.asarray(self.h_mask[g]).tolist(),
+                **{k: np.asarray(v[g]).tolist() for k, v in arrays.items()},
+            } for g in flagged},
+            "recent_rounds": [{
+                "round": r.round_no,
+                "hs": [[int(a), int(b), int(c), int(d), int(e)]
+                       for a, b, c, d, e in zip(r.hs_g, r.hs_p, r.hs_term,
+                                                r.hs_vote, r.hs_commit)],
+                "ring": [[int(a), int(b), int(c), int(d)]
+                         for a, b, c, d in zip(r.ring_g, r.ring_p,
+                                               r.ring_i, r.ring_t)],
+                "entries": [[g, i, t, len(p)] for g, i, t, p in r.entries],
+                "confs": list(r.confs),
+            } for r in self._recent_recs],
+        }
+        ddir = os.path.join(self.cfg.data_dir, "diagnostics")
+        os.makedirs(ddir, exist_ok=True)
+        path = os.path.join(ddir, f"violation-{self.round_no:016x}.json")
+        with open(path, "w") as f:
+            json.dump(dump, f)
+        log.critical("engine: CONSENSUS SAFETY VIOLATION in groups %s "
+                     "(conflict at/below commit); state dumped to %s",
+                     flagged, path)
+        raise EngineViolation(
+            f"conflict at/below commit in groups {flagged}; dump: {path}")
 
     def _service_need_host(self, need_host: np.ndarray) -> None:
         """Consume need_host flags: for each flagged group with a live

@@ -509,8 +509,15 @@ class V3Applier:
                 return {"header": self._hdr(), "lease_id": lid,
                         "ttl": rec["ttl"], "seq": rec["seq"]}
             if t == "lease_attach":
-                if op["key"] not in rec["keys"]:
-                    rec["keys"].append(op["key"])
+                # Canonicalize at the boundary: b64decode(validate=True)
+                # accepts non-canonical encodings (nonzero trailing bits,
+                # e.g. 'YR==' == b'a'), and _detach_deleted compares
+                # against canonically re-encoded event keys — a verbatim
+                # non-canonical attach would never detach on delete, and a
+                # later revoke would delete an unrelated re-created key.
+                k64 = b64e(b64d(op["key"]))
+                if k64 not in rec["keys"]:
+                    rec["keys"].append(k64)
                 self._persist_lease(lid, rec)
                 return {"header": self._hdr(), "lease_id": lid}
             # lease_revoke. The seq fence: an expiry-driven revoke carries

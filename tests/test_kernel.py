@@ -335,3 +335,27 @@ def test_lost_appends_retransmitted_via_heartbeat_resp():
         "victim not caught up after heal", match_v, last)
     commit = np.asarray(st.commit)[g, victim]
     assert (commit == last).all(), (commit, last)
+
+
+def test_corrupt_commit_flags_violation():
+    # The kernel carries defensive invariant detectors (the TPU-native form
+    # of the reference's log.maybeAppend/commitTo panics): no legal
+    # transition yields commit > last_index, so seeing it means corrupted
+    # device state. It must raise NH_VIOLATION — distinct from the NH_SNAP
+    # serviceable escape — so the host engine dumps state and fails loudly.
+    from etcd_tpu.ops.state import NH_SNAP, NH_VIOLATION
+    cfg, st = make(groups=2, peers=3)
+    st, _ = run_rounds(cfg, st, 60)
+    assert (leader_slot(st) >= 0).all()
+    assert not np.asarray(st.need_host).any()
+    # Artificial corruption: one follower's commit cursor jumps past its
+    # log end.
+    bad_commit = np.asarray(st.commit).copy()
+    slot = 0 if leader_slot(st)[1] != 0 else 1
+    bad_commit[1, slot] = int(np.asarray(st.last_index)[1, slot]) + 7
+    st = st._replace(commit=jnp.asarray(bad_commit))
+    st, _ = run_rounds(cfg, st, 1)
+    nh = np.asarray(st.need_host)
+    assert nh[1, slot] & NH_VIOLATION, nh
+    # Healthy group 0 stays clean.
+    assert not (nh[0] & NH_VIOLATION).any(), nh
