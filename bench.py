@@ -520,7 +520,7 @@ def child_main() -> int:
             for r in range(5):   # warm the serving loop
                 offer(r)
                 eng.run_round()
-            a0 = int(eng.applied.sum())
+            a0 = eng.acked_requests
             t0 = time.time()
             r = 0
             while time.time() < sc_deadline - 1.0 or r < 10:
@@ -530,7 +530,7 @@ def child_main() -> int:
                 if r >= 100000:
                     break
             elapsed = time.time() - t0
-            acked = int(eng.applied.sum()) - a0
+            acked = eng.acked_requests - a0
             # Drain: a few empty rounds ack the final sampled waiters so
             # the collector reaches the sentinel, and the join completes
             # BEFORE percentiles read lat_samples (no concurrent appends,

@@ -129,6 +129,7 @@ class TenantAPI:
             "round_ms_ewma": round(eng.round_ms_ewma, 3),
             "groups_with_leader": leaders,
             "applied_total": int(eng.applied.sum()),
+            "acked_requests": eng.acked_requests,
             "pending_payloads": len(eng.payloads),
         })
 

@@ -6,12 +6,18 @@ raft.MultiNode (raft/multinode.go:166-322) + raftNode (etcdserver/raft.go:
 112-172), re-expressed for the batched kernel (etcd_tpu/ops/kernel.py):
 
   one engine round =
-    batch proposals -> kernel.step (ONE XLA program for all G x P) ->
-    read back state deltas -> EngineWAL append+fsync (persist BEFORE the
-    next round consumes this round's messages — the batched form of the
-    doc.go:31-39 ordering contract) -> apply committed entries to the
-    per-group stores -> trigger client waiters -> consume need_host flags
-    (snapshot-install lagging followers via host-side state surgery).
+    batch proposals -> ASYNC kernel.step dispatch (ONE XLA program for all
+    G x P) -> flush the PREVIOUS round while the device computes: EngineWAL
+    append+fsync, then apply committed entries to the per-group stores,
+    then trigger client waiters (acks strictly follow their round's fsync
+    — the doc.go:31-39 ordering contract; the flush-while-stepping overlap
+    is the batched form of the reference's apply/persist pipeline,
+    etcdserver/raft.go:112-172) -> read back state deltas -> consume
+    need_host flags (snapshot-install lagging followers via host-side
+    state surgery). On the single-host crash model, letting round k+1's
+    device step start before round k's fsync completes is safe: a crash
+    truncates the WAL at a round boundary no client ever observed, and
+    device state never survives a crash anyway.
 
 Entry payloads never touch the device: the kernel commits (index, term)
 metadata; payloads live in the host log store keyed (group, index, term) —
@@ -193,6 +199,13 @@ class MultiEngine:
         # Last few durable round records, kept for the violation dump.
         self._recent_recs: deque = deque(maxlen=8)
         self.failed: Optional[Exception] = None
+        # Apply/persist pipelining (the batched form of the reference's
+        # raftNode apply-while-persist overlap, etcdserver/raft.go:112-172):
+        # round k's WAL fsync + store applies + acks run while the device
+        # computes round k+1. Held here between rounds; flushed by the next
+        # round's dispatch, a checkpoint, a conf change, or stop().
+        self._deferred_rec: Optional[RoundRecord] = None
+        self._deferred_apply = False
 
         # Host mirrors of the last read-back device state.
         self.h_term = np.zeros((G, P), np.int32)
@@ -203,6 +216,10 @@ class MultiEngine:
         self.h_ring = np.zeros((G, P, W), np.int32)
         self.h_mask = np.zeros((G, P), bool)
         self.applied = np.zeros(G, np.int64)
+        # Client REQUESTS acked in LIVE rounds (not entries: a batched
+        # entry carries many; restart replay does not count). The
+        # serving-throughput counter — meters measure deltas.
+        self.acked_requests = 0
         self.payloads: Dict[Tuple[int, int, int], bytes] = {}
 
         ckpt_round, ckpt = self.wal.load_checkpoint()
@@ -401,7 +418,29 @@ class MultiEngine:
         self._stop_ev.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # A wedged device round still owns the WAL and the
+                # deferred state; flushing or closing under it would race.
+                log.error("engine thread did not stop in 10s; leaving "
+                          "final round unflushed")
+                return
+        if self.failed is None:
+            self._flush_deferred()
         self.wal.close()
+
+    def _flush_deferred(self) -> None:
+        """Persist + apply + ack the last processed round: WAL append
+        (fsync) strictly before the applies whose results get acked. On an
+        append failure the deferred state stays intact — a retry must
+        re-persist before anything acks, never ack around the hole."""
+        rec = self._deferred_rec
+        if rec is not None:
+            self.wal.append(rec)
+            self._recent_recs.append(rec)
+            self._deferred_rec = None
+        if self._deferred_apply:
+            self._deferred_apply = False
+            self._apply_committed(trigger=True)
 
     def store(self, g: int) -> Store:
         s = self._stores.get(g)
@@ -600,7 +639,8 @@ class MultiEngine:
                 prop_count[g] = len(ents)
                 prop_slot[g] = s
 
-        # -- 2. the kernel round (fused step + routing: one dispatch) -----
+        # -- 2. the kernel round (fused step + routing: one ASYNC
+        # dispatch; jax queues it and returns immediately) ----------------
         tick = (self.round_no % self.cfg.ticks_per_round) == 0
         st, inbox = self._step_fn(
             self.st, self.inbox,
@@ -611,7 +651,16 @@ class MultiEngine:
         self.st = st
         self.inbox = inbox
 
-        # -- 3. read back -------------------------------------------------
+        # -- 3. flush round k-1 (WAL fsync -> applies -> acks) while the
+        # device computes round k: the apply/persist overlap of reference
+        # etcdserver/raft.go:112-172, re-expressed round-wise. Safe on the
+        # single-host crash model: nothing from round k-1 was acked yet,
+        # and a crash before this fsync simply truncates the WAL at a
+        # round boundary no client ever observed. (Acks still strictly
+        # follow their round's fsync.)
+        self._flush_deferred()
+
+        # -- 4. read back round k (blocks until the device finishes) ------
         (term, vote, commit, state, last, ring, need_host) = (
             np.array(a) for a in
             self._jax.device_get((st.term, st.vote, st.commit, st.state,
@@ -626,7 +675,7 @@ class MultiEngine:
             if viol.any():
                 self._fail_violation(viol)
 
-        # -- 4. durable round record --------------------------------------
+        # -- 5. durable round record --------------------------------------
         rec = RoundRecord(round_no=self.round_no)
         chg = (term != self.h_term) | (vote != self.h_vote) | \
               (commit != self.h_commit)
@@ -679,18 +728,22 @@ class MultiEngine:
         self.h_term, self.h_vote, self.h_commit = term, vote, commit
         self.h_state, self.h_last, self.h_ring = state, last, ring
 
-        # -- 5+6. persist, then apply + ack -------------------------------
-        # Membership flips committed this round must be in the SAME durable
-        # record as the round that commits them (replay re-applies them),
-        # so collect them before the append, apply after.
+        # -- 6. defer this round's persist+apply+ack to overlap with the
+        # NEXT round's device step. Membership flips committed this round
+        # must be in the SAME durable record as the round that commits
+        # them (replay re-applies them), so collect them before deferring
+        # — and conf traffic forces a SYNCHRONOUS flush: applying a conf
+        # performs device-state surgery that must precede the next
+        # dispatch.
         rec.confs.extend(self._collect_committed_confs())
-        if not rec.is_empty():
-            self.wal.append(rec)
-            self._recent_recs.append(rec)
-        self._apply_committed(trigger=True)
+        self._deferred_rec = rec if not rec.is_empty() else None
+        self._deferred_apply = True
+        if rec.confs or self._confs_outstanding:
+            self._flush_deferred()
 
         # -- 7. need_host: snapshot-install lagging followers (violations
-        # already failed the round before the WAL append above).
+        # already failed the round before anything was persisted or
+        # acked).
         if need_host.any():
             self._service_need_host(need_host)
 
@@ -701,6 +754,7 @@ class MultiEngine:
         else:
             self.round_ms_ewma += 0.05 * (ms - self.round_ms_ewma)
         if self.round_no % self.cfg.checkpoint_rounds == 0:
+            self._flush_deferred()   # checkpoint state must be consistent
             self._checkpoint()
             self._gc_payloads()
 
@@ -784,6 +838,7 @@ class MultiEngine:
                     except errors.EtcdError as err:
                         result = err
                     if trigger:
+                        self.acked_requests += 1
                         self.wait.trigger(r.id, result)
                 elif payload[0] == P_MULTI:
                     # Coalesced entry: each request applies independently
@@ -797,6 +852,7 @@ class MultiEngine:
                         except errors.EtcdError as err:
                             result = err
                         if trigger:
+                            self.acked_requests += 1
                             self.wait.trigger(r.id, result)
                 elif payload[0] == P_CONF:
                     d = json.loads(payload[1:].decode())
