@@ -125,8 +125,8 @@ def child_main() -> int:
     # --- Phase 1: staggered elections converge in 3 rounds ----------------
     t0 = time.time()
     for r in range(8):
-        st, inbox = kernel.step_routed(cfg, st, inbox, zero, zero,
-                                       jnp.asarray(True))
+        st, inbox = kernel.step_routed_auto(cfg, st, inbox, zero, zero,
+                                            jnp.asarray(True))
         state = np.asarray(st.state)
         if (np.sum(state == LEADER, axis=1) >= 1).all():
             break
@@ -209,8 +209,8 @@ def child_main() -> int:
         for r in range(warm):
             queue += zr
             a_w, pc = staged(queue)
-            st, inbox = kernel.step_routed(cfg, st, inbox, jnp.asarray(pc),
-                                           slots, jnp.asarray(True))
+            st, inbox = kernel.step_routed_auto(
+                cfg, st, inbox, jnp.asarray(pc), slots, jnp.asarray(True))
             li, ci, _ = extract(st, slots)
             li_np = np.asarray(li)
             adm_w = np.minimum(a_w, (li_np - li_prev) * B)
@@ -226,8 +226,8 @@ def child_main() -> int:
         while n < min(max_rounds, 400):
             queue += zr
             a_w, pc = staged(queue)
-            st, inbox = kernel.step_routed(cfg, st, inbox, jnp.asarray(pc),
-                                           slots, jnp.asarray(True))
+            st, inbox = kernel.step_routed_auto(
+                cfg, st, inbox, jnp.asarray(pc), slots, jnp.asarray(True))
             li, ci, _ = extract(st, slots)
             li_np = np.asarray(li)
             adm_w = np.minimum(a_w, (li_np - li_prev) * B)
@@ -315,8 +315,8 @@ def child_main() -> int:
             drop, extra["lagged_groups"] = lag_mask(slots_np)
 
         def one_round(r, st, inbox, slots, drop):
-            st, inbox = kernel.step_routed(cfg, st, inbox, full, slots,
-                                           jnp.asarray(True))
+            st, inbox = kernel.step_routed_auto(cfg, st, inbox, full, slots,
+                                                jnp.asarray(True))
             if drop is not None:
                 inbox = inbox * drop
             return st, inbox

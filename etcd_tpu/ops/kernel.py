@@ -646,35 +646,227 @@ def step(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
 
     Phase order (the scalar equivalence harness mirrors it exactly):
     tick -> messages by sender slot 0..P-1 -> proposals -> quorum commit ->
-    send assembly.
+    send assembly -> defensive invariant check (the reference's
+    log.maybeAppend/commitTo panics: commit past the log end means
+    corrupted state and raises NH_VIOLATION).
     """
+    return _step_body(cfg, st, inbox, prop_count, prop_slot, tick,
+                      quiet=False)
+
+
+# ---------------------------------------------------------------------------
+# Quiescent fast path
+#
+# In steady state (every group led, no elections or term changes in flight)
+# the P sequential message passes above are overkill: leaders receive ONLY
+# append/heartbeat responses — whose progress updates live in per-sender
+# columns and therefore commute across senders — and each follower receives
+# AT MOST one append-or-heartbeat, from its leader (one leader per term;
+# send assembly emits one message per (leader, target) per round). Both
+# facts collapse the message phase into ONE vectorized pass. step_auto
+# checks the quiescence predicate on device and lax.cond-selects the fast
+# or the full path — election rounds automatically take the full path, so
+# the two are behaviorally identical (tests/test_quiet_path.py drives
+# bit-exactness round by round).
+# ---------------------------------------------------------------------------
+
+def _quiet_pred(st: GroupState, cfg: KernelConfig, inbox: jax.Array,
+                active: jax.Array, tick: jax.Array) -> jax.Array:
+    """() bool: NOTHING this round can need the sequential message phases.
+    Conservative — false positives are impossible, false negatives only
+    cost a slow round."""
+    mtype = inbox[..., F_TYPE]
+    present = mtype != M_NONE
+    vote_ish = present & ((mtype == M_VOTE) | (mtype == M_VOTE_RESP))
+    # Any cross-term message (stale or new-term) needs the term gate.
+    term_mism = present & (inbox[..., F_TERM] != st.term[:, :, None])
+    is_c = active & (st.state == CANDIDATE)
+    # A follower whose clock would reach its election timeout this round
+    # might campaign (and must draw from the PRNG stream either way).
+    could_campaign = (tick & active & (st.state != LEADER)
+                      & (st.elapsed + 1 >= cfg.election_tick))
+    n_lead = jnp.sum((active & (st.state == LEADER)).astype(jnp.int32),
+                     axis=1)
+    pending_host = st.need_host != 0
+    return ~(jnp.any(vote_ish) | jnp.any(term_mism) | jnp.any(is_c)
+             | jnp.any(could_campaign) | jnp.any(n_lead > 1)
+             | jnp.any(pending_host))
+
+
+def _quiet_msgs(st: GroupState, cfg: KernelConfig, inbox: jax.Array,
+                active: jax.Array) -> Tuple[GroupState, jax.Array]:
+    """One-pass message processing for quiescent rounds; returns (state,
+    resp) with resp shaped (G, P, P, F) like the full path's."""
+    G, P = st.term.shape
+    F = cfg.fields
+    mtype_all = inbox[..., F_TYPE]
+    is_l = st.state == LEADER
+    recv = active[..., None]
+
+    # -- responses to leaders: per-sender columns are independent, so all
+    # P columns update in one shot (the q-loop of the full path exists
+    # only for cross-column state transitions, which quiescence excludes).
+    mindex_all = inbox[..., F_INDEX]
+    mreject_all = inbox[..., F_REJECT]
+    mhint_all = inbox[..., F_HINT]
+    ar = recv & is_l[..., None] & (mtype_all == M_APP_RESP)
+    match, nxt = st.match, st.next
+    prs, paused = st.pr_state, st.paused
+
+    rej = ar & (mreject_all != 0)
+    repl_rej = rej & (prs == PR_REPLICATE) & (mindex_all > match)
+    probe_rej = rej & (prs == PR_PROBE) & (nxt - 1 == mindex_all)
+    nxt = _where(repl_rej, match + 1, nxt)
+    nxt = _where(probe_rej,
+                 jnp.maximum(jnp.minimum(mindex_all, mhint_all + 1), 1), nxt)
+    prs = _where(repl_rej, PR_PROBE, prs)
+    paused = _where(probe_rej, False, paused)
+
+    ok = ar & (mreject_all == 0)
+    upd = ok & (match < mindex_all)
+    match = _where(upd, mindex_all, match)
+    paused = _where(upd, False, paused)
+    prs = _where(upd & (prs == PR_PROBE), PR_REPLICATE, prs)
+    nxt = jnp.maximum(nxt, _where(ok, mindex_all + 1, 0))
+    ack_age = _where(ar, 0, st.ack_age)
+
+    hrs = recv & is_l[..., None] & (mtype_all == M_HB_RESP)
+    stale = (hrs & (prs == PR_REPLICATE)
+             & (match < st.last_index[..., None])
+             & (ack_age > 2 * cfg.heartbeat_tick + 2))
+    nxt = _where(stale, match + 1, nxt)
+    st = st._replace(match=match, next=nxt, pr_state=prs, paused=paused,
+                     ack_age=ack_age)
+
+    # -- the one append-or-heartbeat each follower may hold: reduce over
+    # the sender axis (at most one slot is populated — one leader per
+    # term), then process it exactly like the full path's single-message
+    # case.
+    fm = recv & ~is_l[..., None] & ((mtype_all == M_APP)
+                                    | (mtype_all == M_HB))
+    has_fm = jnp.any(fm, axis=2)
+    s_idx = jnp.argmax(fm, axis=2).astype(jnp.int32)      # (G, P)
+    onehot_s = (jnp.arange(P, dtype=jnp.int32)[None, None, :]
+                == s_idx[..., None])
+    # dtype pinned: under x64 test configs jnp.sum promotes int32 -> int64.
+    msg = jnp.sum(inbox * (fm & onehot_s)[..., None].astype(jnp.int32),
+                  axis=2, dtype=jnp.int32)                 # (G, P, F)
+    mtype = jnp.where(has_fm, msg[..., F_TYPE], M_NONE)
+    mindex = msg[..., F_INDEX]
+    mlogterm = msg[..., F_LOGTERM]
+    mcommit = msg[..., F_COMMIT]
+    mnent = msg[..., F_NENT]
+    ent_terms = msg[..., N_FIXED_FIELDS:]
+
+    resp_f = jnp.zeros((G, P, F), jnp.int32)
+    a = has_fm & (mtype == M_APP)
+    h = has_fm & (mtype == M_HB)
+    st = st._replace(
+        elapsed=_where(a | h, 0, st.elapsed),
+        lead=_where(a | h, s_idx + 1, st.lead),
+    )
+
+    below_commit = a & (mindex < st.commit)
+    resp_f = _stage(resp_f, below_commit, M_APP_RESP, st.term,
+                    index=st.commit)
+    chk = a & ~below_commit
+    prev_t = term_at(st, cfg, mindex)
+    prev_in_win = in_window(st, cfg, mindex)
+    escape = chk & ~prev_in_win & (mindex <= st.last_index)
+    st = st._replace(need_host=_flag(st.need_host, escape, NH_SNAP))
+
+    match_ok = chk & ~escape & prev_in_win & (prev_t == mlogterm)
+    rej_m = chk & ~escape & ~match_ok
+    resp_f = _stage(resp_f, rej_m, M_APP_RESP, st.term, index=mindex,
+                    reject=True, hint=st.last_index)
+
+    E = cfg.max_ents
+    idx_j = mindex[..., None] + 1 + jnp.arange(E, dtype=jnp.int32)[None, None]
+    valid_j = jnp.arange(E)[None, None] < mnent[..., None]
+    my_t = _terms_at_many(st, cfg, idx_j)
+    mismatch = valid_j & (my_t != ent_terms)
+    any_conf = match_ok & jnp.any(mismatch, axis=-1)
+    first_j = jnp.argmax(mismatch, axis=-1)
+    ci = _where(any_conf, mindex + 1 + first_j, 0)
+    st = st._replace(need_host=_flag(st.need_host,
+                                     any_conf & (ci <= st.commit),
+                                     NH_VIOLATION))
+    st = _write_terms(st, cfg, anchor=mindex, terms=ent_terms, lo=ci,
+                      count=mnent, mask=any_conf)
+    lastnewi = mindex + mnent
+    old_last = st.last_index
+    st = st._replace(
+        last_index=_where(any_conf, lastnewi, st.last_index))
+    shrink = any_conf & (old_last > lastnewi)
+    w_idx = jnp.arange(cfg.window, dtype=jnp.int32)[None, None, :]
+    i_w = old_last[..., None] - jnp.mod(old_last[..., None] - w_idx,
+                                        cfg.window)
+    strand = shrink[..., None] & (i_w > lastnewi[..., None])
+    st = st._replace(log_term=jnp.where(strand, 0, st.log_term))
+    new_commit = jnp.maximum(st.commit, jnp.minimum(mcommit, lastnewi))
+    st = st._replace(commit=_where(match_ok, new_commit, st.commit))
+    resp_f = _stage(resp_f, match_ok, M_APP_RESP, st.term, index=lastnewi)
+
+    st = st._replace(
+        commit=_where(h, jnp.maximum(st.commit,
+                                     jnp.minimum(mcommit, st.last_index)),
+                      st.commit))
+    resp_f = _stage(resp_f, h, M_HB_RESP, st.term)
+
+    # Route each follower's response back to its sender slot.
+    resp = (resp_f[:, :, None, :]
+            * onehot_s[..., None].astype(jnp.int32))        # (G, P, P, F)
+    return st, resp
+
+
+def _step_body(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
+               prop_count: jax.Array, prop_slot: jax.Array, tick: jax.Array,
+               quiet: bool) -> Tuple[GroupState, jax.Array]:
+    """Shared round skeleton; `quiet` (Python bool, traced twice under the
+    cond) selects the message-phase implementation."""
     active = active_mask(st)
     P = st.term.shape[1]
-    # Age every target's silence counter (clamped; see ack_age docs).
     st = st._replace(ack_age=jnp.minimum(st.ack_age + 1, 1 << 20))
-
     st, hb_fire, vote_fire = _tick(st, cfg, active, tick)
-    # Leadership term entering the message phase: a leader demoted by a
-    # later same-round message keeps its right to commit acks it
-    # processed while leading (see _quorum_commit).
     lead_term0 = _where(st.state == LEADER, st.term, 0)
-
-    resp = jnp.zeros((st.term.shape[0], P, P, cfg.fields), jnp.int32)
-    for q in range(P):  # unrolled: P is small and static
-        st, r = _step_msgs_from(st, cfg, q, inbox[:, :, q, :], active)
-        resp = resp.at[:, :, q, :].set(r)
-
+    if quiet:
+        st, resp = _quiet_msgs(st, cfg, inbox, active)
+    else:
+        resp = jnp.zeros((st.term.shape[0], P, P, cfg.fields), jnp.int32)
+        for q in range(P):
+            st, r = _step_msgs_from(st, cfg, q, inbox[:, :, q, :], active)
+            resp = resp.at[:, :, q, :].set(r)
     st = _apply_proposals(st, cfg, prop_count, prop_slot, active)
     st = _quorum_commit(st, cfg, active, lead_term0)
     st, outbox = _assemble_sends(st, cfg, resp, hb_fire, vote_fire, active)
-    # Defensive invariant detector (the reference's log.maybeAppend /
-    # commitTo panics): a commit cursor past the log end can only mean
-    # corrupted state — no legal transition produces it. Like the
-    # conflict-at/below-commit flag above, this is a NH_VIOLATION the host
-    # must treat as fatal, not a serviceable escape.
     bad = active & (st.commit > st.last_index)
     st = st._replace(need_host=_flag(st.need_host, bad, NH_VIOLATION))
     return st, outbox
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+def step_routed_auto(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
+                     prop_count: jax.Array, prop_slot: jax.Array,
+                     tick: jax.Array) -> Tuple[GroupState, jax.Array]:
+    """step + route_local with on-device fast-path selection: quiescent
+    rounds (the steady-state common case) skip the P sequential message
+    passes. ONE compiled program; lax.cond executes exactly one branch at
+    runtime."""
+    active = active_mask(st)
+    quiet = _quiet_pred(st, cfg, inbox, active, tick)
+
+    def fast(ops):
+        st, inbox, pc, ps, tick = ops
+        s, out = _step_body(cfg, st, inbox, pc, ps, tick, quiet=True)
+        return s, route_local(out)
+
+    def full(ops):
+        st, inbox, pc, ps, tick = ops
+        s, out = _step_body(cfg, st, inbox, pc, ps, tick, quiet=False)
+        return s, route_local(out)
+
+    return jax.lax.cond(quiet, fast, full,
+                        (st, inbox, prop_count, prop_slot, tick))
 
 
 def route_local(outbox: jax.Array) -> jax.Array:

@@ -152,10 +152,13 @@ def init_state(cfg: KernelConfig, n_peers=None,
     elapsed0 = np.zeros((G, P), np.int32)
     if stagger:
         g = np.arange(G)
-        slot = (g % n_peers_np).astype(np.int64)
+        # Guard mod-by-zero: groups with n_peers == 0 are unprovisioned
+        # pool slots (engine tenant lifecycle) — no staggered campaigner.
+        slot = (g % np.maximum(n_peers_np, 1)).astype(np.int64)
         # After the first tick, d = 2*tick+1 - tick = tick+1 > any draw in
         # [0, tick-1] -> guaranteed immediate campaign (see kernel._tick).
-        elapsed0[g, slot] = 2 * cfg.election_tick
+        elapsed0[g, slot] = np.where(n_peers_np > 0,
+                                     2 * cfg.election_tick, 0)
 
     # Each field gets its OWN buffer: step() donates the whole state pytree,
     # and XLA rejects donating one buffer twice.
@@ -197,17 +200,31 @@ def quorum(st: GroupState) -> jax.Array:
 
 
 def ring_lookup(ring: jax.Array, slot: jax.Array) -> jax.Array:
-    """ring[..., W] indexed at slot[..., K] -> [..., K], as a one-hot
-    select-sum over the W axis. On TPU this compiles to a fused
-    broadcast-multiply-reduce on the vector unit; the equivalent
-    take_along_axis gather lowers to serialized dynamic slices and
-    dominated the whole kernel's round time (profiled: the two ring
-    gathers were ~55% of a step at G=100k)."""
-    W = ring.shape[-1]
-    iota = jnp.arange(W, dtype=slot.dtype)
-    onehot = (slot[..., None] == iota).astype(ring.dtype)
-    # dtype pinned: under x64 configs jnp.sum would promote int32 -> int64.
-    return jnp.sum(ring[..., None, :] * onehot, axis=-1, dtype=ring.dtype)
+    """ring[..., W] indexed at slot[..., K] -> [..., K]. Backend-dispatched
+    at trace time:
+
+    - TPU: one-hot select-sum over the W axis — compiles to a fused
+      broadcast-multiply-reduce on the vector unit; the equivalent
+      take_along_axis gather lowers to serialized dynamic slices and
+      dominated the whole kernel's round time (profiled: the two ring
+      gathers were ~55% of a step at G=100k).
+    - CPU (and other backends): take_along_axis — the one-hot form
+      materializes an extra (..., K, W) intermediate (104MB at the G=4096
+      bench shape in send assembly alone) that a CPU gather avoids.
+
+    Both are elementwise-exact; the trajectory tests drive them against
+    the same oracle."""
+    if jax.default_backend() == "tpu":
+        W = ring.shape[-1]
+        iota = jnp.arange(W, dtype=slot.dtype)
+        onehot = (slot[..., None] == iota).astype(ring.dtype)
+        # dtype pinned: under x64 configs jnp.sum promotes int32 -> int64.
+        return jnp.sum(ring[..., None, :] * onehot, axis=-1,
+                       dtype=ring.dtype)
+    shape = jnp.broadcast_shapes(ring.shape[:-1], slot.shape[:-1])
+    ring_b = jnp.broadcast_to(ring, shape + ring.shape[-1:])
+    slot_b = jnp.broadcast_to(slot, shape + slot.shape[-1:])
+    return jnp.take_along_axis(ring_b, slot_b, axis=-1)
 
 
 def term_at(st: GroupState, cfg: KernelConfig, index: jax.Array) -> jax.Array:

@@ -121,6 +121,11 @@ class EngineConfig:
     fsync: bool = True
     checkpoint_rounds: int = 2048     # rounds between full checkpoints
     request_timeout: float = 5.0
+    # How often the host scans tenant stores for DUE TTL expirations and
+    # stages a replicated SYNC into those groups (reference SyncTicker,
+    # etcdserver/server.go:667-681; expiry must ride the log so replay
+    # after restart deletes identically). 0 disables.
+    sync_interval: float = 0.5
     # Max client requests coalesced into ONE log entry (group commit). The
     # device commits (index, term) metadata only, so entry payloads are
     # free to carry many requests — this is what lets a hot tenant drain
@@ -132,6 +137,13 @@ class EngineConfig:
     ticks_per_round: int = 1          # logical clock rate
     stagger: bool = True              # deterministic fast first election
     initial_peers: Optional[int] = None  # active slots at fresh boot (<= peers)
+    # Tenants (groups) provisioned at fresh boot. None = all `groups` (the
+    # pre-lifecycle behavior); smaller values leave the rest of the pool
+    # inactive (peer_mask all-false: no elections, no ticks) for runtime
+    # create_tenant()/remove_tenant() — the engine's CreateGroup/
+    # RemoveGroup (reference raft/multinode.go:181-218), without
+    # recompilation: the kernel shape is the POOL, liveness is the mask.
+    initial_tenants: Optional[int] = None
     # Optional jax.sharding.Mesh with ("groups", "peers") axes
     # (parallel/mesh.py): the kernel state shards over it and the per-round
     # message routing becomes an all_to_all over the "peers" mesh axis —
@@ -175,8 +187,15 @@ class MultiEngine:
                 donate_argnums=(0, 1),
                 out_shardings=(self._st_sh, self._mb_sh))
         else:
-            self._step_fn = lambda st, inbox, pc, ps, t: kernel.step_routed(
-                self.kcfg, st, inbox, pc, ps, t)
+            # step_routed_auto: quiescent rounds (the serving steady
+            # state) take the one-pass fast path; election/term-change
+            # rounds take the full sequential path — selected on device,
+            # bit-identical trajectories (tests/test_quiet_path.py). The
+            # mesh path stays on the full kernel: lax.cond around sharded
+            # collectives constrains layouts for no serving benefit there.
+            self._step_fn = (
+                lambda st, inbox, pc, ps, t: kernel.step_routed_auto(
+                    self.kcfg, st, inbox, pc, ps, t))
 
         # Geometry guard BEFORE anything touches the data dir: a mismatch
         # must refuse the dir before the WAL opens/creates any file in it.
@@ -206,6 +225,9 @@ class MultiEngine:
         # round's dispatch, a checkpoint, a conf change, or stop().
         self._deferred_rec: Optional[RoundRecord] = None
         self._deferred_apply = False
+        self._last_sync_scan = 0.0
+        # g -> redeadline for the one in-flight SYNC allowed per tenant.
+        self._sync_pending: Dict[int, float] = {}
 
         # Host mirrors of the last read-back device state.
         self.h_term = np.zeros((G, P), np.int32)
@@ -229,7 +251,7 @@ class MultiEngine:
         if ckpt is not None or recs:
             self._restore(ckpt_round, ckpt, recs)
         else:
-            self.st = init_state(self.kcfg, n_peers=cfg.initial_peers,
+            self.st = init_state(self.kcfg, n_peers=self._boot_peers(),
                                  stagger=cfg.stagger)
             self.h_mask = np.asarray(self.st.peer_mask).copy()
         if self._st_sh is not None:
@@ -242,6 +264,17 @@ class MultiEngine:
         # Chaos hook: (G, P_to, P_from, 1)-broadcastable 0/1 mask applied to
         # the routed inbox (tests inject drops/partitions here).
         self.drop_mask = None
+
+    def _boot_peers(self):
+        """Per-group active-slot counts at fresh boot: the first
+        initial_tenants groups get initial_peers (or all P) slots, the
+        rest of the pool stays unprovisioned (all-false mask rows)."""
+        n = self.cfg.initial_peers or self.cfg.peers
+        if self.cfg.initial_tenants is None:
+            return n
+        arr = np.zeros(self.cfg.groups, np.int32)
+        arr[:min(self.cfg.initial_tenants, self.cfg.groups)] = n
+        return arr
 
     def _check_geometry(self) -> None:
         """Persist (groups, peers, window) beside the WAL and refuse a
@@ -293,7 +326,7 @@ class MultiEngine:
         jnp = self._jnp
         G, P, W = self.cfg.groups, self.cfg.peers, self.cfg.window
 
-        base = init_state(self.kcfg, n_peers=self.cfg.initial_peers,
+        base = init_state(self.kcfg, n_peers=self._boot_peers(),
                           stagger=self.cfg.stagger)
         self.h_mask = np.asarray(base.peer_mask).copy()
         if ckpt is not None:
@@ -464,11 +497,13 @@ class MultiEngine:
         return int(idx[0]) if len(idx) else -1
 
     def wait_leaders(self, timeout: float = 30.0, groups=None) -> bool:
-        """Block until every (requested) group has a leader."""
+        """Block until every (requested) PROVISIONED group has a leader —
+        unprovisioned pool slots have no peers and never elect."""
         deadline = time.monotonic() + timeout
-        gs = range(self.cfg.groups) if groups is None else groups
         while time.monotonic() < deadline:
-            if all(self.leader_slot(g) >= 0 for g in gs):
+            gs = (np.nonzero(self.h_mask.any(axis=1))[0]
+                  if groups is None else groups)
+            if all(self.leader_slot(int(g)) >= 0 for g in gs):
                 return True
             time.sleep(0.005)
         return False
@@ -542,6 +577,26 @@ class MultiEngine:
             raise result
         return result
 
+    def _stage_syncs(self, now: float) -> None:
+        """Enqueue METHOD_SYNC for every tenant whose store holds an
+        expiration <= now. At most one SYNC in flight per tenant (a
+        leaderless group must not accumulate one queued SYNC per interval);
+        the inflight marker self-heals by deadline in case the SYNC entry
+        is orphaned by a leader change and never applies."""
+        due = [g for g, s in list(self._stores.items())
+               if (x := s.next_expiration()) is not None and x <= now
+               and self._sync_pending.get(g, 0.0) <= now]
+        if not due:
+            return
+        redeadline = now + max(2.0, 10 * self.cfg.sync_interval)
+        with self._lock:
+            for g in due:
+                self._sync_pending[g] = redeadline
+                r = Request(method=METHOD_SYNC, time=now,
+                            id=self.reqid.next())
+                self._pending[g].append((r.id, bytes([P_REQ]) + r.encode()))
+                self._dirty.add(g)
+
     def status(self, g: int) -> dict:
         """Introspection snapshot for one group (/debug/vars analogue)."""
         lead = self.leader_slot(g)
@@ -597,6 +652,15 @@ class MultiEngine:
         jnp, kernel = self._jnp, self._kernel
         G, P, W, E = (self.cfg.groups, self.cfg.peers, self.cfg.window,
                       self.cfg.max_ents)
+
+        # -- 0. TTL expiry: stage a replicated SYNC into tenants holding a
+        # DUE expiration (leader-clock cutoff; deletion applies — and
+        # replays — deterministically from the log).
+        if self.cfg.sync_interval:
+            now = time.time()
+            if now - self._last_sync_scan >= self.cfg.sync_interval:
+                self._last_sync_scan = now
+                self._stage_syncs(now)
 
         # -- 1. stage proposals at known leaders --------------------------
         prop_count = np.zeros(G, np.int32)
@@ -838,7 +902,8 @@ class MultiEngine:
                     except errors.EtcdError as err:
                         result = err
                     if trigger:
-                        self.acked_requests += 1
+                        if r.method != METHOD_SYNC:  # engine-internal
+                            self.acked_requests += 1
                         self.wait.trigger(r.id, result)
                 elif payload[0] == P_MULTI:
                     # Coalesced entry: each request applies independently
@@ -895,6 +960,7 @@ class MultiEngine:
             return st.get(r.path, r.recursive, r.sorted)
         if r.method == METHOD_SYNC:
             st.delete_expired_keys(r.time)
+            self._sync_pending.pop(g, None)
             return None
         raise errors.EtcdError(errors.ECODE_INVALID_FORM,
                                cause=f"bad method {r.method}")

@@ -403,6 +403,16 @@ class Store:
         with self._lock:
             return self.ttl_heap.top(self._resolve) is not None
 
+    def next_expiration(self) -> Optional[float]:
+        """Earliest live expire time, or None. The multi-tenant engine
+        stages a SYNC only for tenants with a DUE expiry (the reference
+        proposes SYNC unconditionally on a 500ms ticker,
+        etcdserver/server.go:667-681 — per-cluster that's one no-op entry,
+        across 100k tenant groups it would be 100k)."""
+        with self._lock:
+            n = self.ttl_heap.top(self._resolve)
+            return None if n is None else n.expire_time
+
     def json_stats(self) -> dict:
         with self._lock:
             self.stats.watchers = self.watcher_hub.count

@@ -654,3 +654,48 @@ def test_engine_batches_hot_group_writes(tmp_path):
     for i in (0, 42, 99):
         assert eng2.store(0).get(f"/k{i}", False, False).node.value == str(i)
     eng2.wal.close()
+
+
+def test_engine_ttl_expiry_watch_and_restart(tmp_path):
+    # VERDICT r2 item 5: TTL keys in engine tenants must expire via a
+    # replicated leader SYNC (reference SyncTicker server.go:667-681): the
+    # watch fires an "expire" event, and the deletion — riding the log —
+    # survives restart replay.
+    from etcd_tpu import errors as _err
+
+    cfg = make_cfg(tmp_path, sync_interval=0.02)
+    eng = MultiEngine(cfg)
+    run_until(eng, lambda: eng.leader_slot(1) >= 0, msg="leader")
+
+    exp = time.time() + 0.4
+    out = {}
+
+    def work():
+        out["res"] = eng.do(1, Request(method="PUT", path="/ttl",
+                                       val="v", expiration=exp))
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    settle(eng, t, out)
+    w = eng.do(1, Request(method="GET", path="/ttl", wait=True))
+
+    deadline = time.time() + 10
+    expired = False
+    while time.time() < deadline:
+        eng.run_round()
+        time.sleep(0.01)
+        try:
+            eng.store(1).get("/ttl", False, False)
+        except _err.EtcdError:
+            expired = True
+            break
+    assert expired, "TTL key never expired in engine mode"
+    ev = w.next_event(timeout=5.0)
+    assert ev is not None and ev.action == "expire", ev
+
+    # Restart: the SYNC replays from the WAL; the key must stay gone.
+    eng.stop()
+    eng2 = MultiEngine(cfg)
+    with pytest.raises(_err.EtcdError):
+        eng2.store(1).get("/ttl", False, False)
+    eng2.wal.close()

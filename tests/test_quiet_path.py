@@ -1,0 +1,85 @@
+"""The quiescent fast path (kernel.step_routed_auto) must be TRAJECTORY-
+IDENTICAL to the full kernel: its on-device predicate may only select the
+one-pass message phase when that phase is bit-exact with the P sequential
+passes, so stepping the same schedule through both functions — elections,
+proposals, partitions, re-elections — must agree on every state field and
+the routed inbox after every round.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from etcd_tpu.ops import kernel
+from etcd_tpu.ops.state import LEADER, KernelConfig, init_state
+
+
+def _fields(st):
+    return {k: np.asarray(v) for k, v in st._asdict().items()}
+
+
+def _assert_same(sa, sb, ia, ib, r):
+    fa, fb = _fields(sa), _fields(sb)
+    for k in fa:
+        assert np.array_equal(fa[k], fb[k]), \
+            f"round {r}: field {k} diverged\n{fa[k]}\n{fb[k]}"
+    assert np.array_equal(np.asarray(ia), np.asarray(ib)), \
+        f"round {r}: inbox diverged"
+
+
+def test_auto_matches_full_trajectory():
+    G, P = 8, 5
+    cfg = KernelConfig(groups=G, peers=P, window=8, max_ents=2,
+                       election_tick=10, heartbeat_tick=3)
+    rng = np.random.default_rng(7)
+
+    st_f = init_state(cfg, stagger=True)
+    st_a = init_state(cfg, stagger=True)
+    # Separate buffers: the stepping functions donate their inputs.
+    in_f = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+    in_a = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+    zero = jnp.zeros(G, jnp.int32)
+
+    quiet_rounds = 0
+    drop = None
+    for r in range(260):
+        # Mid-run chaos: partition group 3's leader for 25 rounds to force
+        # a re-election (auto must fall back to the full path), then heal.
+        if r == 120 or r == 145:
+            state = np.asarray(st_f.state)
+            lead3 = int((state[3] == LEADER).argmax())
+            m_to = np.ones((G, P, 1, 1), np.int32)
+            m_from = np.ones((G, 1, P, 1), np.int32)
+            if r == 120:
+                m_to[3, lead3] = 0
+                m_from[3, 0, lead3] = 0
+                drop = jnp.asarray(m_to * m_from)
+            else:
+                drop = None
+
+        # Proposals at the full-state's current leaders (identical states
+        # => identical slots).
+        state = np.asarray(st_f.state)
+        has_lead = (state == LEADER).any(axis=1)
+        slots = jnp.asarray((state == LEADER).argmax(axis=1)
+                            .astype(np.int32))
+        pc = jnp.asarray(
+            (rng.integers(0, cfg.max_ents + 1, size=G)
+             * has_lead).astype(np.int32)) if r % 3 else zero
+
+        quiet_rounds += bool(kernel._quiet_pred(
+            st_f, cfg, in_f, st_f.peer_mask, jnp.asarray(True)))
+
+        st_f, in_f = kernel.step_routed(cfg, st_f, in_f, pc, slots,
+                                        jnp.asarray(True))
+        st_a, in_a = kernel.step_routed_auto(cfg, st_a, in_a, pc, slots,
+                                             jnp.asarray(True))
+        if drop is not None:
+            in_f = in_f * drop
+            in_a = in_a * drop
+        _assert_same(st_f, st_a, in_f, in_a, r)
+
+    commit = np.asarray(st_f.commit)
+    assert (commit.max(axis=1) > 10).all(), commit
+    # The fast path must actually have engaged (and not always).
+    assert quiet_rounds > 100, quiet_rounds
+    assert quiet_rounds < 260, quiet_rounds
