@@ -20,6 +20,8 @@ import json
 import time
 from typing import Dict
 
+import numpy as np
+
 from etcd_tpu import errors, version
 from etcd_tpu.etcdhttp.client import ClientAPI
 from etcd_tpu.etcdhttp.web import Ctx, HttpServer, Router
@@ -72,10 +74,47 @@ class TenantAPI:
         self._apis: Dict[int, ClientAPI] = {}
 
     def install(self, router: Router) -> None:
+        router.add("/tenants", self.handle_tenants_root, exact=True)
         router.add("/tenants/", self.handle_tenants)
         router.add("/engine/status", self.handle_engine_status)
         router.add("/health", self.handle_health)
         router.add("/version", self.handle_version)
+
+    def handle_tenants_root(self, ctx: Ctx, suffix: str) -> None:
+        """GET /tenants lists provisioned tenants; POST /tenants
+        provisions one at the lowest free pool slot (optional body
+        {"peers": n}) — the runtime CreateGroup of reference
+        raft/multinode.go:181-218."""
+        if ctx.method == "GET":
+            ctx.send_json(200, {"tenants": self.engine.tenants(),
+                                "pool": self.engine.cfg.groups})
+            return
+        if ctx.method != "POST":
+            ctx.send(405, b"Method Not Allowed",
+                     headers={"Allow": "GET, POST"})
+            return
+        self._create(ctx, None)
+
+    def _create(self, ctx: Ctx, g) -> None:
+        try:
+            body = json.loads(ctx.body.decode() or "{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            n = body.get("peers")
+            if n is not None:
+                n = int(n)
+            gid = self.engine.create_tenant(g, n)
+        except errors.EtcdError as e:
+            ctx.send(e.status_code, e.to_json().encode() + b"\n",
+                     "application/json")
+            return
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            ctx.send_json(400, {"message": f"bad create body: {e}"})
+            return
+        # Creation always assigns slots 0..n-1 (deterministic — no racy
+        # re-read of the live mask here).
+        n = n or self.engine.cfg.initial_peers or self.engine.cfg.peers
+        ctx.send_json(201, {"tenant": gid, "active_slots": list(range(n))})
 
     def _api(self, g: int) -> ClientAPI:
         api = self._apis.get(g)
@@ -92,6 +131,30 @@ class TenantAPI:
                 raise ValueError
         except ValueError:
             ctx.send_json(404, {"message": f"no such tenant {parts[0]!r}"})
+            return
+        # Lifecycle verbs on the bare /tenants/{g} path.
+        if rest == "":
+            if ctx.method == "PUT":
+                self._create(ctx, g)
+            elif ctx.method == "DELETE":
+                try:
+                    self.engine.remove_tenant(g)
+                except errors.EtcdError as e:
+                    ctx.send(e.status_code, e.to_json().encode() + b"\n",
+                             "application/json")
+                    return
+                ctx.send_json(200, {"removed": g})
+            elif ctx.method == "GET":
+                if self.engine.tenant_active(g):
+                    ctx.send_json(200, self.engine.status(g))
+                else:
+                    ctx.send_json(404, {"message": f"no such tenant {g}"})
+            else:
+                ctx.send(405, b"Method Not Allowed",
+                         headers={"Allow": "GET, PUT, DELETE"})
+            return
+        if not self.engine.tenant_active(g):
+            ctx.send_json(404, {"message": f"tenant {g} not provisioned"})
             return
         if rest == "v2/keys" or rest.startswith("v2/keys/"):
             self._api(g).handle_keys(ctx, rest[len("v2/keys"):])
@@ -124,6 +187,7 @@ class TenantAPI:
                       if eng.leader_slot(g) >= 0)
         ctx.send_json(200, {
             "groups": eng.cfg.groups,
+            "tenants_active": len(eng.tenants()),
             "peers": eng.cfg.peers,
             "round": eng.round_no,
             "round_ms_ewma": round(eng.round_ms_ewma, 3),

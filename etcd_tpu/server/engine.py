@@ -228,6 +228,13 @@ class MultiEngine:
         self._last_sync_scan = 0.0
         # g -> redeadline for the one in-flight SYNC allowed per tenant.
         self._sync_pending: Dict[int, float] = {}
+        # Tenant-lifecycle admin ops: (op dict, done Event, result dict),
+        # processed at a round boundary by the engine loop; acks fire only
+        # after the round record carrying the flips is fsynced.
+        self._admin_q: deque = deque()
+        self._admin_flips: List[Tuple[int, int, int]] = []
+        self._admin_acks: List[threading.Event] = []
+        self._deferred_admin_acks: List[threading.Event] = []
 
         # Host mirrors of the last read-back device state.
         self.h_term = np.zeros((G, P), np.int32)
@@ -286,6 +293,7 @@ class MultiEngine:
         import os
         from etcd_tpu.utils.fileutil import touch_dir_all
         touch_dir_all(self.cfg.data_dir)
+        self._grew_from: Optional[int] = None
         path = os.path.join(self.cfg.data_dir, "geometry.json")
         want = {"groups": self.cfg.groups, "peers": self.cfg.peers,
                 "window": self.cfg.window}
@@ -293,10 +301,25 @@ class MultiEngine:
             with open(path) as f:
                 have = json.load(f)
             if have != want:
+                # The pool may GROW (tenant lifecycle: restart with more
+                # groups; restore pads the arrays, WAL group ids stay
+                # valid). Peer/window shapes and shrinking still refuse.
+                if (have["peers"] == want["peers"]
+                        and have["window"] == want["window"]
+                        and want["groups"] > have["groups"]):
+                    # Remember the old pool size: groups beyond it were
+                    # never provisioned, whatever the boot defaults say.
+                    self._grew_from = have["groups"]
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(want, f)
+                    os.replace(tmp, path)
+                    return
                 raise ValueError(
                     f"engine data dir {self.cfg.data_dir} was initialized "
                     f"with geometry {have}, refusing to open with {want} — "
-                    "move the data dir aside or match the flags")
+                    "move the data dir aside or match the flags (only the "
+                    "group pool may grow)")
         else:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
@@ -329,14 +352,29 @@ class MultiEngine:
         base = init_state(self.kcfg, n_peers=self._boot_peers(),
                           stagger=self.cfg.stagger)
         self.h_mask = np.asarray(base.peer_mask).copy()
+        if self._grew_from is not None:
+            # Pool slots added by a post-boot growth were never
+            # provisioned — the checkpoint pad and the WAL both know
+            # nothing of them.
+            self.h_mask[self._grew_from:] = False
+        def pool_pad(a):
+            """Pad checkpoint arrays along the group axis when the pool
+            grew since the checkpoint (new slots: zeroed, unprovisioned)."""
+            if a.shape[0] < G:
+                pad = np.zeros((G - a.shape[0],) + a.shape[1:], a.dtype)
+                return np.concatenate([a, pad], axis=0)
+            return a
+
         if ckpt is not None:
-            self.h_term = b64_np(ckpt["term"]).astype(np.int32)
-            self.h_vote = b64_np(ckpt["vote"]).astype(np.int32)
-            self.h_commit = b64_np(ckpt["commit"]).astype(np.int32)
-            self.h_last = b64_np(ckpt["last"]).astype(np.int32)
-            self.h_ring = b64_np(ckpt["ring"]).astype(np.int32)
-            self.h_mask = b64_np(ckpt["mask"]).astype(bool)
-            self.applied = b64_np(ckpt["applied"]).astype(np.int64)
+            self.h_term = pool_pad(b64_np(ckpt["term"]).astype(np.int32))
+            self.h_vote = pool_pad(b64_np(ckpt["vote"]).astype(np.int32))
+            self.h_commit = pool_pad(b64_np(ckpt["commit"])
+                                     .astype(np.int32))
+            self.h_last = pool_pad(b64_np(ckpt["last"]).astype(np.int32))
+            self.h_ring = pool_pad(b64_np(ckpt["ring"]).astype(np.int32))
+            self.h_mask = pool_pad(b64_np(ckpt["mask"]).astype(bool))
+            self.applied = pool_pad(b64_np(ckpt["applied"])
+                                    .astype(np.int64))
             for g_s, blob in ckpt["stores"].items():
                 st = Store()
                 st.recovery(blob.encode())
@@ -403,6 +441,18 @@ class MultiEngine:
                     self.h_last[g, slot] = 0
                     self.h_ring[g, slot] = 0
                     slot_log.pop((int(g), int(slot)), None)
+                elif not self.h_mask[g].any():
+                    # This REMOVE flip deprovisioned the tenant: replay the
+                    # host-side reset AT THIS POINT in the flip sequence —
+                    # a remove+re-create batched into the same record must
+                    # reset between the two, or the re-created tenant's
+                    # fresh indices land below the stale apply cursor and
+                    # acked writes vanish while old data resurfaces.
+                    g = int(g)
+                    self.applied[g] = 0
+                    self._stores.pop(g, None)
+                    for k in [k for k in self.payloads if k[0] == g]:
+                        del self.payloads[k]
         self.round_no = last_round + 1
 
         # Device state: followers everywhere, logs/HS restored.
@@ -471,6 +521,11 @@ class MultiEngine:
             self.wal.append(rec)
             self._recent_recs.append(rec)
             self._deferred_rec = None
+        if self._deferred_admin_acks:
+            # Tenant create/remove is durable now; release the requesters.
+            for ev in self._deferred_admin_acks:
+                ev.set()
+            self._deferred_admin_acks = []
         if self._deferred_apply:
             self._deferred_apply = False
             self._apply_committed(trigger=True)
@@ -577,6 +632,141 @@ class MultiEngine:
             raise result
         return result
 
+    # ------------------------------------------------------------------
+    # tenant lifecycle (the engine's CreateGroup/RemoveGroup — reference
+    # raft/multinode.go:181-218 — over a fixed pre-compiled pool)
+    # ------------------------------------------------------------------
+
+    def tenant_active(self, g: int) -> bool:
+        """Provisioned = at least one active peer slot."""
+        return bool(self.h_mask[g].any())
+
+    def tenants(self) -> List[int]:
+        return [int(g) for g in np.nonzero(self.h_mask.any(axis=1))[0]]
+
+    def create_tenant(self, g: Optional[int] = None,
+                      n_peers: Optional[int] = None,
+                      timeout: Optional[float] = None) -> int:
+        """Provision a tenant group at runtime (g=None allocates the
+        lowest free pool slot). Returns the group id once the creation is
+        DURABLE (its conf flips fsynced in a round record). No
+        recompilation: the kernel shape is the pool; creation is a masked
+        state reset + peer-mask flips, exactly the shape a committed
+        membership change already takes in the WAL — so replay needs no
+        new machinery."""
+        n = n_peers or self.cfg.initial_peers or self.cfg.peers
+        if not 1 <= n <= self.cfg.peers:
+            raise ValueError(f"n_peers {n} out of range 1..{self.cfg.peers}")
+        return self._admin({"op": "create", "g": g, "n": n}, timeout)
+
+    def remove_tenant(self, g: int,
+                      timeout: Optional[float] = None) -> int:
+        """Deprovision a tenant: all peer slots go inactive, its store,
+        payloads and pending proposals are dropped (pending waiters get an
+        error), and the pool slot becomes reusable."""
+        return self._admin({"op": "remove", "g": int(g)}, timeout)
+
+    def _admin(self, op: dict, timeout: Optional[float]) -> int:
+        done = threading.Event()
+        out: dict = {}
+        item = (op, done, out)
+        with self._lock:
+            self._admin_q.append(item)
+        if not done.wait(timeout or self.cfg.request_timeout):
+            # Withdraw the op if it never started — a timed-out create must
+            # not silently provision later (a client retry would then
+            # consume a second pool slot). If it already left the queue,
+            # give the in-flight execution a short grace.
+            with self._lock:
+                try:
+                    self._admin_q.remove(item)
+                    withdrawn = True
+                except ValueError:
+                    withdrawn = False
+            if withdrawn or not done.wait(2.0):
+                raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
+                                       cause="tenant admin op timed out")
+        if "err" in out:
+            raise out["err"]
+        return out["g"]
+
+    def _process_admin(self) -> None:
+        """Apply queued tenant ops at a round boundary: device surgery via
+        the shared per-slot conf machinery (CONF_ADD zeroes the slot on
+        both live and replay paths — a freshly created tenant IS a set of
+        added slots), flips recorded into THIS round's durable record, and
+        requester acks deferred until that record is fsynced."""
+        self._flush_deferred()   # applies must not straddle the surgery
+        with self._lock:
+            ops = list(self._admin_q)
+            self._admin_q.clear()
+        for op, done, out in ops:
+            try:
+                if op["op"] == "create":
+                    g = op["g"]
+                    if g is None:
+                        free = np.nonzero(~self.h_mask.any(axis=1))[0]
+                        if not len(free):
+                            raise errors.EtcdError(
+                                errors.ECODE_RAFT_INTERNAL,
+                                cause=f"tenant pool exhausted "
+                                      f"({self.cfg.groups} groups)")
+                        g = int(free[0])
+                    g = int(g)
+                    if not 0 <= g < self.cfg.groups:
+                        raise errors.EtcdError(
+                            errors.ECODE_KEY_NOT_FOUND,
+                            cause=f"group {g} outside pool")
+                    if self.h_mask[g].any():
+                        raise errors.EtcdError(
+                            errors.ECODE_NODE_EXIST,
+                            cause=f"tenant {g} already provisioned")
+                    self._tenant_reset(g)
+                    for s in range(op["n"]):
+                        self._apply_conf(g, "add", s, admin=True)
+                        self._admin_flips.append((g, s, CONF_ADD))
+                    # Fast first election (same trick as boot stagger).
+                    el = np.asarray(self.st.elapsed).copy()
+                    el[g, g % op["n"]] = 2 * self.cfg.election_tick
+                    self.st = self.st._replace(
+                        elapsed=self._dev("elapsed", el))
+                    out["g"] = g
+                else:
+                    g = int(op["g"])
+                    if not (0 <= g < self.cfg.groups
+                            and self.h_mask[g].any()):
+                        raise errors.EtcdError(
+                            errors.ECODE_KEY_NOT_FOUND,
+                            cause=f"no such tenant {g}")
+                    for s in np.nonzero(self.h_mask[g])[0]:
+                        self._apply_conf(g, "remove", int(s), admin=True)
+                        self._admin_flips.append((g, int(s), CONF_REMOVE))
+                    self._tenant_reset(g)
+                    out["g"] = g
+            except Exception as e:  # noqa: BLE001 — relayed to requester
+                out["err"] = e
+                done.set()
+                continue
+            self._admin_acks.append(done)
+
+    def _tenant_reset(self, g: int) -> None:
+        """Drop all host-side state of a pool slot (store, payloads,
+        apply cursor, queued proposals)."""
+        st = self._stores.pop(g, None)
+        if st is not None:
+            st.watcher_hub.clear()   # wake/close blocked watchers
+        self.applied[g] = 0
+        self._sync_pending.pop(g, None)
+        for k in [k for k in self.payloads if k[0] == g]:
+            del self.payloads[k]
+        with self._lock:
+            dq = self._pending[g]
+            while dq:
+                rid, _ = dq.popleft()
+                self.wait.trigger(rid, errors.EtcdError(
+                    errors.ECODE_RAFT_INTERNAL, cause="tenant removed"))
+            self._dirty.discard(g)
+
     def _stage_syncs(self, now: float) -> None:
         """Enqueue METHOD_SYNC for every tenant whose store holds an
         expiration <= now. At most one SYNC in flight per tenant (a
@@ -652,6 +842,10 @@ class MultiEngine:
         jnp, kernel = self._jnp, self._kernel
         G, P, W, E = (self.cfg.groups, self.cfg.peers, self.cfg.window,
                       self.cfg.max_ents)
+
+        # -- -1. tenant lifecycle admin ops (rare; round-boundary surgery)
+        if self._admin_q:
+            self._process_admin()
 
         # -- 0. TTL expiry: stage a replicated SYNC into tenants holding a
         # DUE expiration (leader-clock cutoff; deletion applies — and
@@ -800,8 +994,14 @@ class MultiEngine:
         # performs device-state surgery that must precede the next
         # dispatch.
         rec.confs.extend(self._collect_committed_confs())
+        if self._admin_flips:
+            rec.confs.extend(self._admin_flips)
+            self._admin_flips = []
         self._deferred_rec = rec if not rec.is_empty() else None
         self._deferred_apply = True
+        if self._admin_acks:
+            self._deferred_admin_acks.extend(self._admin_acks)
+            self._admin_acks = []
         if rec.confs or self._confs_outstanding:
             self._flush_deferred()
 
@@ -969,13 +1169,18 @@ class MultiEngine:
     # host surgery: conf changes + snapshot install
     # ------------------------------------------------------------------
 
-    def _apply_conf(self, g: int, op: str, slot: int) -> None:
+    def _apply_conf(self, g: int, op: str, slot: int,
+                    admin: bool = False) -> None:
         """Flip a membership bit at a committed boundary and reset the
         affected progress/vote columns (reference raft.go addNode/
-        removeNode + multinode.go:181-218)."""
+        removeNode + multinode.go:181-218). admin=True flips come from the
+        tenant-lifecycle path, which never incremented the outstanding-conf
+        counter — decrementing would steal a concurrent real conf change's
+        count and disable its committed-conf binding scan."""
         add = (op == "add")
-        with self._lock:   # pairs with conf_change's locked increment
-            self._confs_outstanding = max(0, self._confs_outstanding - 1)
+        if not admin:
+            with self._lock:   # pairs with conf_change's locked increment
+                self._confs_outstanding = max(0, self._confs_outstanding - 1)
         self.h_mask[g, slot] = add
         mask = self._dev("peer_mask", self.h_mask)
 

@@ -322,13 +322,21 @@ def test_engine_geometry_mismatch_refused(tmp_path):
     eng = MultiEngine(EngineConfig(groups=4, peers=3, window=16,
                                    data_dir=d, fsync=False))
     eng.stop()
+    # Peer/window changes and pool SHRINKS refuse; growth is allowed
+    # (tenant lifecycle: the pool may be enlarged across restarts).
     with pytest.raises(ValueError, match="geometry"):
-        MultiEngine(EngineConfig(groups=8, peers=3, window=16,
+        MultiEngine(EngineConfig(groups=4, peers=5, window=16,
                                  data_dir=d, fsync=False))
-    # Same geometry reopens fine.
+    with pytest.raises(ValueError, match="geometry"):
+        MultiEngine(EngineConfig(groups=2, peers=3, window=16,
+                                 data_dir=d, fsync=False))
+    # Same geometry reopens fine; a grown pool also reopens fine.
     eng2 = MultiEngine(EngineConfig(groups=4, peers=3, window=16,
                                     data_dir=d, fsync=False))
     eng2.stop()
+    eng3 = MultiEngine(EngineConfig(groups=8, peers=3, window=16,
+                                    data_dir=d, fsync=False))
+    eng3.stop()
 
 
 def test_engine_mesh_flag_serves(tmp_path):
