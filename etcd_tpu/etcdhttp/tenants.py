@@ -20,8 +20,6 @@ import json
 import time
 from typing import Dict
 
-import numpy as np
-
 from etcd_tpu import errors, version
 from etcd_tpu.etcdhttp.client import ClientAPI
 from etcd_tpu.etcdhttp.web import Ctx, HttpServer, Router

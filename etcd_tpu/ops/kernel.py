@@ -37,7 +37,7 @@ streams, same draw points (reference raft.go:765-771 semantics).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -461,6 +461,28 @@ def _write_terms(st: GroupState, cfg: KernelConfig, anchor: jax.Array,
 # Phase 3: proposals
 # ---------------------------------------------------------------------------
 
+def _apply_proposals_slots(st: GroupState, cfg: KernelConfig,
+                           cnt_gp: jax.Array,
+                           active: jax.Array) -> GroupState:
+    """Per-SLOT proposal admission for the multi-host engine: cnt_gp is
+    (G, P), SHARDED like the state over the peers mesh axis — each host
+    stages proposals only at its own local leader slots, so no replicated
+    (and therefore cross-host-agreed) input is needed. Semantics match
+    _apply_proposals with prop_slot = the slot whose count is nonzero;
+    non-leader slots admit nothing."""
+    is_ldr = active & (st.state == LEADER)
+    tail = st.last_index - st.commit
+    room = jnp.maximum(0, cfg.window // 2 - tail)
+    cnt = jnp.minimum(jnp.minimum(cnt_gp, cfg.max_ents), room)
+    cnt = cnt * is_ldr.astype(jnp.int32)
+    E = cfg.max_ents
+    terms = jnp.broadcast_to(st.term[..., None], (*st.term.shape, E))
+    st = _write_terms(st, cfg, anchor=st.last_index, terms=terms,
+                      lo=st.last_index + 1, count=cnt, mask=cnt > 0)
+    st = st._replace(last_index=st.last_index + cnt)
+    return _set_self_progress(st)
+
+
 def _apply_proposals(st: GroupState, cfg: KernelConfig, prop_count: jax.Array,
                      prop_slot: jax.Array, active: jax.Array) -> GroupState:
     """The addressed leader appends `prop_count[g]` new entries of its term
@@ -820,10 +842,12 @@ def _quiet_msgs(st: GroupState, cfg: KernelConfig, inbox: jax.Array,
 
 
 def _step_body(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
-               prop_count: jax.Array, prop_slot: jax.Array, tick: jax.Array,
-               quiet: bool) -> Tuple[GroupState, jax.Array]:
+               prop_count: jax.Array, prop_slot: Optional[jax.Array],
+               tick: jax.Array, quiet: bool) -> Tuple[GroupState, jax.Array]:
     """Shared round skeleton; `quiet` (Python bool, traced twice under the
-    cond) selects the message-phase implementation."""
+    cond) selects the message-phase implementation. prop_slot=None selects
+    per-SLOT proposal admission (prop_count is then (G, P) — the
+    multi-host engine's sharded input)."""
     active = active_mask(st)
     P = st.term.shape[1]
     st = st._replace(ack_age=jnp.minimum(st.ack_age + 1, 1 << 20))
@@ -836,7 +860,10 @@ def _step_body(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
         for q in range(P):
             st, r = _step_msgs_from(st, cfg, q, inbox[:, :, q, :], active)
             resp = resp.at[:, :, q, :].set(r)
-    st = _apply_proposals(st, cfg, prop_count, prop_slot, active)
+    if prop_slot is None:
+        st = _apply_proposals_slots(st, cfg, prop_count, active)
+    else:
+        st = _apply_proposals(st, cfg, prop_count, prop_slot, active)
     st = _quorum_commit(st, cfg, active, lead_term0)
     st, outbox = _assemble_sends(st, cfg, resp, hb_fire, vote_fire, active)
     bad = active & (st.commit > st.last_index)
@@ -875,6 +902,20 @@ def route_local(outbox: jax.Array) -> jax.Array:
     (reference rafthttp/, 4187 lines) collapses to this when peers are
     co-located as array rows."""
     return jnp.swapaxes(outbox, 1, 2)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+def step_routed_slots(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
+                      cnt_gp: jax.Array, tick: jax.Array
+                      ) -> Tuple[GroupState, jax.Array]:
+    """Multi-host serving step: per-SLOT proposal counts (G, P) sharded
+    like the state (see _apply_proposals_slots), full sequential message
+    path, fused routing — an all_to_all over the peers mesh axis when the
+    state is sharded across hosts (the ICI/DCN consensus transport of
+    SURVEY §2.4)."""
+    st, outbox = _step_body(cfg, st, inbox, cnt_gp, None, tick,
+                            quiet=False)
+    return st, route_local(outbox)
 
 
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))

@@ -230,11 +230,10 @@ class MultiEngine:
         self._sync_pending: Dict[int, float] = {}
         # Tenant-lifecycle admin ops: (op dict, done Event, result dict),
         # processed at a round boundary by the engine loop; acks fire only
-        # after the round record carrying the flips is fsynced.
+        # after the record carrying the flips is fsynced.
         self._admin_q: deque = deque()
         self._admin_flips: List[Tuple[int, int, int]] = []
         self._admin_acks: List[threading.Event] = []
-        self._deferred_admin_acks: List[threading.Event] = []
 
         # Host mirrors of the last read-back device state.
         self.h_term = np.zeros((G, P), np.int32)
@@ -521,11 +520,6 @@ class MultiEngine:
             self.wal.append(rec)
             self._recent_recs.append(rec)
             self._deferred_rec = None
-        if self._deferred_admin_acks:
-            # Tenant create/remove is durable now; release the requesters.
-            for ev in self._deferred_admin_acks:
-                ev.set()
-            self._deferred_admin_acks = []
         if self._deferred_apply:
             self._deferred_apply = False
             self._apply_committed(trigger=True)
@@ -694,8 +688,14 @@ class MultiEngine:
         """Apply queued tenant ops at a round boundary: device surgery via
         the shared per-slot conf machinery (CONF_ADD zeroes the slot on
         both live and replay paths — a freshly created tenant IS a set of
-        added slots), flips recorded into THIS round's durable record, and
-        requester acks deferred until that record is fsynced."""
+        added slots). The flips are persisted in their OWN record at this
+        boundary, BEFORE the upcoming round's record: live surgery happens
+        before the round runs, so replay must zero the slot before it sees
+        that round's term/vote/commit deltas — appending the flips to the
+        round's record would replay them AFTER its HS deltas and wipe the
+        new group's first campaign (a restarted slot could then re-vote at
+        a term it already voted in). Requester acks fire after the flips'
+        fsync."""
         self._flush_deferred()   # applies must not straddle the surgery
         with self._lock:
             ops = list(self._admin_q)
@@ -748,6 +748,15 @@ class MultiEngine:
                 done.set()
                 continue
             self._admin_acks.append(done)
+        if self._admin_flips:
+            rec = RoundRecord(round_no=self.round_no)
+            rec.confs.extend(self._admin_flips)
+            self._admin_flips = []
+            self.wal.append(rec)          # fsync: the op is durable NOW
+            self._recent_recs.append(rec)
+        for done in self._admin_acks:
+            done.set()
+        self._admin_acks = []
 
     def _tenant_reset(self, g: int) -> None:
         """Drop all host-side state of a pool slot (store, payloads,
@@ -994,14 +1003,8 @@ class MultiEngine:
         # performs device-state surgery that must precede the next
         # dispatch.
         rec.confs.extend(self._collect_committed_confs())
-        if self._admin_flips:
-            rec.confs.extend(self._admin_flips)
-            self._admin_flips = []
         self._deferred_rec = rec if not rec.is_empty() else None
         self._deferred_apply = True
-        if self._admin_acks:
-            self._deferred_admin_acks.extend(self._admin_acks)
-            self._admin_acks = []
         if rec.confs or self._confs_outstanding:
             self._flush_deferred()
 

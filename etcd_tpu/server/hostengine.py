@@ -1,0 +1,848 @@
+"""HostEngine: the MULTI-HOST MultiEngine — N processes, each owning one
+peer-slot column of every Raft group, stepping ONE global SPMD kernel.
+
+Deployment shape (the reference's cluster model re-expressed for a device
+mesh): host h contributes one device to a ("groups", "peers") mesh and owns
+peer slot h of every group. The consensus hot path — votes, appends,
+acks, commit metadata — is the kernel's routed mailbox, which XLA lowers
+to an all_to_all across the peers axis: ICI within a slice, DCN between
+hosts (SURVEY §2.4). What the reference moves over rafthttp that is NOT
+index metadata rides the frame transport (parallel/frames.py): forwarded
+client proposals, entry payload fan-out, and payload catch-up pulls.
+
+Durability model (reference per-member WAL, etcdserver/raft.go:112-172):
+every host journals ITS OWN slot column's per-round deltas plus every
+entry payload it admits or receives to its own EngineWAL, and fsyncs
+BEFORE dispatching the next round — the persist-before-send contract
+(raft/doc.go:31-39) holds across hosts because round k's outbox is only
+delivered by round k+1's collective, which this host cannot enter before
+its fsync returns. (The single-host engine's fsync/step overlap is NOT
+legal here: peers are separate failure domains.)
+
+Every host applies every group's store (exactly a reference member's state
+machine) and acks a client request only after its OWN fsync + apply — so
+an acked write is always reconstructable from the acking host's WAL alone,
+and Raft's quorum machinery guarantees the cluster converges to include it.
+
+Crash model: a host crash stalls the synchronous collective, so the JOB
+restarts (all hosts), each replaying its own WAL — zero acked writes lost.
+Availability during a single-host outage is traded for the dense SPMD data
+plane; divergence from the reference's per-member liveness is documented
+in docs/divergences.md.
+
+Proposal flow: a client hits ANY host; if the leader slot of the target
+group is local it stages directly (per-slot proposal counts are SHARDED
+kernel inputs — no cross-host agreement needed, ops/kernel.py
+step_routed_slots); otherwise the request forwards to the leader's host
+over a PROPOSE frame (nonblocking, bounded, drop = client timeout —
+reference peer.go:156-165 semantics).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from etcd_tpu import errors
+from etcd_tpu.parallel.frames import FrameTransport
+from etcd_tpu.server.engine import (P_MULTI, P_REQ, _pack_entry,
+                                    _unpack_multi)
+from etcd_tpu.server.enginewal import EngineWAL, RoundRecord, b64_np, np_b64
+from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
+                                     METHOD_PUT, METHOD_QGET, METHOD_SYNC,
+                                     Request)
+from etcd_tpu.store import Store
+from etcd_tpu.utils import idutil
+from etcd_tpu.utils.wait import Wait
+
+log = logging.getLogger("etcd_tpu.hostengine")
+
+_LEADER = 2
+_MAX_HOPS = 3
+
+
+@dataclass
+class HostEngineConfig:
+    groups: int
+    peers: int                 # == number of hosts (one slot column each)
+    data_dir: str              # THIS host's WAL/checkpoint dir
+    host_id: int
+    frame_listen: Tuple[str, int]
+    frame_peers: Dict[int, Tuple[str, int]] = field(default_factory=dict)
+    window: int = 32
+    max_ents: int = 8
+    election_tick: int = 10
+    heartbeat_tick: int = 3
+    fsync: bool = True
+    checkpoint_rounds: int = 4096
+    request_timeout: float = 10.0
+    batch_max: int = 128
+    round_interval: float = 0.0
+    stagger: bool = True
+    pull_interval: float = 0.25    # payload catch-up request pacing
+
+
+class HostEngine:
+    """One host's share of the multi-host MultiEngine."""
+
+    def __init__(self, cfg: HostEngineConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import functools
+        from etcd_tpu.ops import kernel
+        from etcd_tpu.ops.state import KernelConfig, init_state
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from etcd_tpu.parallel.mesh import (mailbox_sharding, shard_state,
+                                            state_sharding)
+
+        self._jax, self._jnp = jax, jnp
+        self.cfg = cfg
+        G, Pn, W = cfg.groups, cfg.peers, cfg.window
+        self.kcfg = KernelConfig(
+            groups=G, peers=Pn, window=W, max_ents=cfg.max_ents,
+            election_tick=cfg.election_tick,
+            heartbeat_tick=cfg.heartbeat_tick)
+
+        devs = sorted(jax.devices(), key=lambda d: d.process_index)
+        if len(devs) != Pn:
+            raise ValueError(
+                f"multi-host engine needs one device per peer slot: "
+                f"{len(devs)} devices for peers={Pn}")
+        assert len(jax.local_devices()) == 1, "one device per host expected"
+        self.my_slot = cfg.host_id
+        assert devs[self.my_slot].process_index == jax.process_index(), (
+            "host_id must equal jax process index (device ordering)")
+        self.mesh = Mesh(np.array(devs).reshape(1, Pn),
+                         axis_names=("groups", "peers"))
+        self._st_sh = state_sharding(self.mesh)
+        self._mb_sh = mailbox_sharding(self.mesh)
+        self._cnt_sh = NamedSharding(self.mesh, P("groups", "peers"))
+        self._step_fn = jax.jit(
+            functools.partial(kernel.step_routed_slots.__wrapped__,
+                              self.kcfg),
+            donate_argnums=(0, 1),
+            out_shardings=(self._st_sh, self._mb_sh))
+
+        self._check_geometry()
+        self.wal = EngineWAL(cfg.data_dir, fsync=cfg.fsync)
+        self.wait = Wait()
+        self.reqid = idutil.Generator(cfg.host_id + 1)
+        self._pending: List[deque] = [deque() for _ in range(G)]
+        self._dirty: set = set()
+        self._staged: Dict[int, List[List[Tuple[int, bytes]]]] = {}
+        self._stores: Dict[int, Store] = {}
+        self._lock = threading.Lock()
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.round_no = 0
+        self.round_ms_ewma = 0.0
+        self.acked_requests = 0
+        self.failed: Optional[Exception] = None
+        self._recent_recs: deque = deque(maxlen=8)
+
+        # Local column mirrors (this host's slot of every group).
+        self.l_term = np.zeros(G, np.int32)
+        self.l_vote = np.zeros(G, np.int32)
+        self.l_commit = np.zeros(G, np.int32)
+        self.l_state = np.zeros(G, np.int32)
+        self.l_last = np.zeros(G, np.int32)
+        self.l_lead = np.zeros(G, np.int32)     # leader slot+1 as we know it
+        self.l_ring = np.zeros((G, W), np.int32)
+        self.applied = np.zeros(G, np.int64)
+        self.payloads: Dict[Tuple[int, int, int], bytes] = {}
+
+        # Inbound frames (filled by transport threads, drained per round).
+        self._rx: deque = deque()
+        # rid -> forward hop count for requests that arrived via PROPOSE
+        # frames (loop protection when leadership views are crossed).
+        self._hops: Dict[int, int] = {}
+        self._fresh_payloads: List[Tuple[int, int, int, bytes]] = []
+        self._missing: Dict[Tuple[int, int, int], float] = {}
+        self._last_pull = 0.0
+        self.unreachable: Dict[int, int] = {}
+
+        self.frames = FrameTransport(
+            cfg.host_id, cfg.frame_listen, cfg.frame_peers,
+            on_frame=self._on_frame,
+            report_unreachable=self._report_unreachable)
+
+        ckpt_round, ckpt = self.wal.load_checkpoint()
+        recs = list(self.wal.replay(after_round=ckpt_round))
+        base = init_state(self.kcfg, stagger=cfg.stagger)
+        if ckpt is not None or recs:
+            self._restore(base, ckpt_round, ckpt, recs)
+        else:
+            self.st = shard_state(base, self.mesh)
+        inbox0 = jnp.zeros((G, Pn, Pn, self.kcfg.fields), jnp.int32)
+        self.inbox = jax.device_put(inbox0, self._mb_sh)
+
+    # ------------------------------------------------------------------
+    # boot / restore
+    # ------------------------------------------------------------------
+
+    def _check_geometry(self) -> None:
+        import os
+        from etcd_tpu.utils.fileutil import touch_dir_all
+        touch_dir_all(self.cfg.data_dir)
+        path = os.path.join(self.cfg.data_dir, "geometry.json")
+        want = {"groups": self.cfg.groups, "peers": self.cfg.peers,
+                "window": self.cfg.window, "host": self.cfg.host_id}
+        if os.path.exists(path):
+            with open(path) as f:
+                have = json.load(f)
+            if have != want:
+                raise ValueError(
+                    f"host-engine data dir {self.cfg.data_dir} was "
+                    f"initialized with {have}, refusing {want}")
+        else:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(want, f)
+            os.replace(tmp, path)
+
+    def _global_col(self, name: str, base_field, local_col: np.ndarray):
+        """Assemble a global sharded array where THIS host's column holds
+        restored local data; every host calls this for its own column."""
+        jax = self._jax
+        base_np = np.asarray(base_field)
+        sh = getattr(self._st_sh, name)
+
+        def cb(index):
+            blk = base_np[index].copy()
+            blk[:, 0] = local_col
+            return blk
+
+        return jax.make_array_from_callback(base_np.shape, sh, cb)
+
+    def _restore(self, base, ckpt_round: int, ckpt: Optional[dict],
+                 recs: List[RoundRecord]) -> None:
+        """Rebuild THIS host's column from its checkpoint + WAL replay;
+        every slot restarts as a follower (reference RestartNode)."""
+        from etcd_tpu.parallel.mesh import shard_state
+        G, W = self.cfg.groups, self.cfg.window
+
+        if ckpt is not None:
+            self.l_term = b64_np(ckpt["term"]).astype(np.int32)
+            self.l_vote = b64_np(ckpt["vote"]).astype(np.int32)
+            self.l_commit = b64_np(ckpt["commit"]).astype(np.int32)
+            self.l_last = b64_np(ckpt["last"]).astype(np.int32)
+            self.l_ring = b64_np(ckpt["ring"]).astype(np.int32)
+            self.applied = b64_np(ckpt["applied"]).astype(np.int64)
+            for g_s, blob in ckpt["stores"].items():
+                st = Store()
+                st.recovery(blob.encode())
+                self._stores[int(g_s)] = st
+            import base64 as _b64
+            for g, i, t, b64p in ckpt["payloads"]:
+                self.payloads[(g, i, t)] = _b64.b64decode(b64p)
+
+        # Our column's log-term history (ring window is finite; the
+        # committed-but-unapplied span can reach further back).
+        slot_log: Dict[int, Dict[int, int]] = {}
+
+        def _log_set(g, i, t):
+            slot_log.setdefault(int(g), {})[int(i)] = int(t)
+
+        if ckpt is not None:
+            for g in range(G):
+                lastv = int(self.l_last[g])
+                for w in range(W):
+                    i = lastv - ((lastv - w) % W)
+                    if i >= 1:
+                        _log_set(g, i, self.l_ring[g, w])
+
+        last_round = ckpt_round
+        for rec in recs:
+            last_round = max(last_round, rec.round_no)
+            for g, t_, v_, c_ in zip(rec.hs_g, rec.hs_term, rec.hs_vote,
+                                     rec.hs_commit):
+                self.l_term[g] = t_
+                self.l_vote[g] = v_
+                self.l_commit[g] = c_
+            for g, i, t in zip(rec.ring_g, rec.ring_i, rec.ring_t):
+                self.l_ring[g, int(i) % W] = t
+                _log_set(g, i, t)
+            for g, new in zip(rec.last_g, rec.last_v):
+                prev = int(self.l_last[g])
+                self.l_last[g] = new
+                for i in range(max(prev + 1, int(new) - W + 1),
+                               int(new) + 1):
+                    _log_set(g, i, self.l_ring[g, i % W])
+            for g, i, t, payload in rec.entries:
+                self.payloads[(g, i, t)] = payload
+        self.round_no = last_round + 1
+
+        hist: Dict[Tuple[int, int], int] = {}
+        for g, entries in slot_log.items():
+            c = int(self.l_commit[g])
+            lastv = int(self.l_last[g])
+            for i, t in entries.items():
+                if t > 0 and i <= c and i <= lastv:
+                    hist[(g, i)] = t
+        self._apply_committed(trigger=False, hist=hist)
+        self._gc_payloads()
+
+        st = shard_state(base, self.mesh)
+        self.st = st._replace(
+            term=self._global_col("term", base.term, self.l_term),
+            vote=self._global_col("vote", base.vote, self.l_vote),
+            commit=self._global_col("commit", base.commit, self.l_commit),
+            last_index=self._global_col("last_index", base.last_index,
+                                        self.l_last),
+            log_term=self._global_col("log_term", base.log_term,
+                                      self.l_ring),
+        )
+        self.l_state = np.zeros(G, np.int32)
+        self.l_lead = np.zeros(G, np.int32)
+
+    # ------------------------------------------------------------------
+    # frames
+    # ------------------------------------------------------------------
+
+    def _report_unreachable(self, h: int) -> None:
+        self.unreachable[h] = self.unreachable.get(h, 0) + 1
+
+    def _on_frame(self, frm: int, header: dict, blob: bytes) -> None:
+        t = header.get("t")
+        if t == "pull":
+            # Answer immediately from the payload store (read-only).
+            haves = [(g, i, tt) for g, i, tt in
+                     (tuple(w) for w in header.get("wants", []))
+                     if (g, i, tt) in self.payloads]
+            if haves:
+                self.frames.send(frm, {"t": "pay"},
+                                 _pack_payloads(
+                                     [(g, i, tt, self.payloads[(g, i, tt)])
+                                      for g, i, tt in haves]))
+            return
+        self._rx.append((frm, header, blob))
+
+    def _drain_frames(self) -> None:
+        G = self.cfg.groups
+        while self._rx:
+            try:
+                frm, header, blob = self._rx.popleft()
+            except IndexError:
+                return
+            # One malformed/hostile frame must never kill the engine loop
+            # (it would stall the whole job's collective): validate, log,
+            # drop.
+            try:
+                t = header.get("t")
+                if t == "prop":
+                    g = int(header["g"])
+                    if not 0 <= g < G:
+                        raise ValueError(f"group {g} out of range")
+                    hops = int(header.get("hops", 0))
+                    if hops >= _MAX_HOPS:
+                        log.warning("dropping proposal for group %d: hop "
+                                    "limit (leadership view unsettled)", g)
+                        continue
+                    items = _unpack_items(blob)
+                    with self._lock:
+                        for rid, _ in items:
+                            self._hops[rid] = hops
+                        self._pending[g].extend(items)
+                        self._dirty.add(g)
+                elif t == "pay":
+                    for g, i, tt, payload in _unpack_payloads(blob):
+                        if not 0 <= g < G:
+                            raise ValueError(f"group {g} out of range")
+                        key = (g, i, tt)
+                        if key not in self.payloads:
+                            self.payloads[key] = payload
+                            self._fresh_payloads.append((g, i, tt, payload))
+                        self._missing.pop(key, None)
+            except Exception:  # noqa: BLE001 — drop the frame, keep serving
+                log.exception("bad frame from host %d dropped", frm)
+
+    # ------------------------------------------------------------------
+    # public API (same shape as MultiEngine where it makes sense)
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"host-engine-{self.my_slot}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=15)
+        self.frames.stop()
+        self.wal.close()
+
+    def store(self, g: int) -> Store:
+        s = self._stores.get(g)
+        if s is None:
+            with self._lock:
+                s = self._stores.get(g)
+                if s is None:
+                    s = self._stores[g] = Store()
+        return s
+
+    def leader_slot(self, g: int) -> int:
+        if self.l_state[g] == _LEADER:
+            return self.my_slot
+        return int(self.l_lead[g]) - 1   # -1 when unknown
+
+    def wait_leaders(self, timeout: float = 60.0, groups=None) -> bool:
+        deadline = time.monotonic() + timeout
+        gs = range(self.cfg.groups) if groups is None else groups
+        while time.monotonic() < deadline:
+            if all(self.leader_slot(g) >= 0 for g in gs):
+                return True
+            time.sleep(0.01)
+        return False
+
+    def tenant_active(self, g: int) -> bool:
+        return 0 <= g < self.cfg.groups
+
+    def tenants(self) -> List[int]:
+        return list(range(self.cfg.groups))
+
+    def create_tenant(self, *a, **kw):
+        raise errors.EtcdError(errors.ECODE_NOT_FILE,
+                               cause="tenant lifecycle is single-host-"
+                                     "engine only (multi-host pool is "
+                                     "fixed at boot)")
+
+    remove_tenant = create_tenant
+
+    def conf_change(self, *a, **kw):
+        raise errors.EtcdError(errors.ECODE_NOT_FILE,
+                               cause="per-group membership is the peers "
+                                     "mesh axis in multi-host mode")
+
+    @property
+    def h_commit(self) -> np.ndarray:
+        return self.l_commit[:, None]
+
+    @property
+    def h_term(self) -> np.ndarray:
+        return self.l_term[:, None]
+
+    @property
+    def h_mask(self) -> np.ndarray:
+        return np.ones((self.cfg.groups, self.cfg.peers), bool)
+
+    def status(self, g: int) -> dict:
+        return {"group": g, "lead": self.leader_slot(g),
+                "term": int(self.l_term[g]),
+                "commit": int(self.l_commit[g]),
+                "applied": int(self.applied[g]),
+                "host": self.my_slot,
+                "active_slots": list(range(self.cfg.peers))}
+
+    def do(self, g: int, r: Request, timeout: Optional[float] = None) -> Any:
+        """Serve one request against group g from THIS host (reads local;
+        writes ride consensus and ack after LOCAL fsync+apply)."""
+        if r.method == METHOD_GET:
+            if r.quorum:
+                r = Request(**{**r.__dict__, "method": METHOD_QGET})
+            elif r.wait:
+                return self.store(g).watch(r.path, r.recursive, r.stream,
+                                           r.since)
+            else:
+                return self.store(g).get(r.path, r.recursive, r.sorted)
+        if r.method not in (METHOD_PUT, METHOD_POST, METHOD_DELETE,
+                            METHOD_QGET, METHOD_SYNC):
+            raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                                   cause=f"bad method {r.method}")
+        if r.id == 0:
+            r = Request(**{**r.__dict__, "id": self.reqid.next()})
+        q = self.wait.register(r.id)
+        payload = bytes([P_REQ]) + r.encode()
+        with self._lock:
+            self._pending[g].append((r.id, payload))
+            self._dirty.add(g)
+        import queue as _q
+        try:
+            result = q.get(timeout=timeout or self.cfg.request_timeout)
+        except _q.Empty:
+            self.wait.cancel(r.id)
+            raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
+                                   cause="request timed out",
+                                   index=int(self.applied[g]))
+        if isinstance(result, errors.EtcdError):
+            raise result
+        return result
+
+    # ------------------------------------------------------------------
+    # the round
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop_ev.is_set():
+                self.run_round()
+                if self.cfg.round_interval:
+                    time.sleep(self.cfg.round_interval)
+        except Exception as e:  # noqa: BLE001
+            self.failed = e
+            self._stop_ev.set()
+            log.exception("host-engine %d loop failed", self.my_slot)
+            raise
+
+    def run_round(self) -> None:
+        t_round = time.perf_counter()
+        jax, jnp = self._jax, self._jnp
+        G, Pn, W, E = (self.cfg.groups, self.cfg.peers, self.cfg.window,
+                       self.cfg.max_ents)
+        B = self.cfg.batch_max
+
+        # -- 1. frames in; stage local, forward remote --------------------
+        self._drain_frames()
+        cnt_local = np.zeros(G, np.int32)
+        self._staged.clear()
+        forwards: List[Tuple[int, int, List[Tuple[int, bytes]]]] = []
+        with self._lock:
+            for g in list(self._dirty):
+                dq = self._pending[g]
+                if not dq:
+                    self._dirty.discard(g)
+                    continue
+                if self.l_state[g] == _LEADER:
+                    ents: List[List[Tuple[int, bytes]]] = []
+                    while dq and len(ents) < E:
+                        cur: List[Tuple[int, bytes]] = []
+                        while (dq and len(cur) < B and dq[0][1]
+                               and dq[0][1][0] == P_REQ):
+                            cur.append(dq.popleft())
+                        if not cur:
+                            dq.popleft()   # drop non-REQ junk defensively
+                            continue
+                        ents.append(cur)
+                    if not dq:
+                        self._dirty.discard(g)
+                    if ents:
+                        for e in ents:
+                            for rid, _ in e:
+                                self._hops.pop(rid, None)
+                        self._staged[g] = ents
+                        cnt_local[g] = len(ents)
+                elif self.l_lead[g] > 0:
+                    lead_host = int(self.l_lead[g]) - 1
+                    items = list(dq)
+                    dq.clear()
+                    self._dirty.discard(g)
+                    forwards.append((lead_host, g, items))
+                # else: no known leader — leave queued, client may time out
+        for lead_host, g, items in forwards:
+            # Hop count = 1 past the furthest-travelled item in the batch
+            # (items that originated here count 0); _drain_frames drops at
+            # the limit, so crossed leadership views can't ping-pong
+            # forever.
+            hops = 1 + max((self._hops.pop(rid, 0) for rid, _ in items),
+                           default=0)
+            self.frames.send(lead_host, {"t": "prop", "g": g, "hops": hops},
+                             _pack_items(items))
+
+        cnt_gp = jax.make_array_from_callback(
+            (G, Pn), self._cnt_sh, lambda idx: cnt_local[idx[0], None])
+
+        # -- 2. the global SPMD round -------------------------------------
+        with self.mesh:
+            st, inbox = self._step_fn(self.st, self.inbox, cnt_gp,
+                                      jnp.asarray(True))
+        self.st = st
+        self.inbox = inbox
+
+        # -- 3. read back OUR column --------------------------------------
+        def local(a):
+            return np.asarray(list(a.addressable_shards)[0].data)
+
+        term = local(st.term)[:, 0]
+        vote = local(st.vote)[:, 0]
+        commit = local(st.commit)[:, 0]
+        state = local(st.state)[:, 0]
+        last = local(st.last_index)[:, 0]
+        lead = local(st.lead)[:, 0]
+        ring = local(st.log_term)[:, 0, :]
+        need_host = local(st.need_host)[:, 0]
+
+        if need_host.any():
+            from etcd_tpu.ops.state import NH_VIOLATION
+            viol = (need_host & NH_VIOLATION) != 0
+            if viol.any():
+                raise RuntimeError(
+                    f"host {self.my_slot}: consensus safety violation in "
+                    f"groups {np.nonzero(viol)[0][:8].tolist()}")
+            # NH_SNAP across hosts: catch-up beyond the ring window needs
+            # a cross-host snapshot protocol; the synchronous collective
+            # loses no messages, so this only fires after pathological
+            # restarts. Loud, not fatal.
+            log.warning("host %d: need_host(NH_SNAP) flags on %d groups "
+                        "(cross-host snapshot install not implemented)",
+                        self.my_slot, int((need_host != 0).sum()))
+
+        # -- 4. durable record for OUR column -----------------------------
+        my = self.my_slot
+        rec = RoundRecord(round_no=self.round_no)
+        chg = ((term != self.l_term) | (vote != self.l_vote)
+               | (commit != self.l_commit))
+        gi = np.nonzero(chg)[0]
+        rec.hs_g = gi.astype(np.uint32)
+        rec.hs_p = np.full(len(gi), my, np.uint16)
+        rec.hs_term = term[gi].astype(np.uint32)
+        rec.hs_vote = vote[gi].astype(np.uint16)
+        rec.hs_commit = commit[gi].astype(np.uint32)
+
+        gi = np.nonzero(last != self.l_last)[0]
+        rec.last_g = gi.astype(np.uint32)
+        rec.last_p = np.full(len(gi), my, np.uint16)
+        rec.last_v = last[gi].astype(np.uint32)
+
+        gi, wi = np.nonzero(ring != self.l_ring)
+        lastv = last[gi]
+        absi = lastv - ((lastv - wi) % W)
+        keep = absi >= 1
+        rec.ring_g = gi[keep].astype(np.uint32)
+        rec.ring_p = np.full(int(keep.sum()), my, np.uint16)
+        rec.ring_i = absi[keep].astype(np.uint32)
+        rec.ring_t = ring[gi[keep], wi[keep]].astype(np.uint32)
+
+        # Admission for locally staged proposals.
+        fresh_frames: List[Tuple[int, int, int, bytes]] = []
+        requeue: List[Tuple[int, List[Tuple[int, bytes]]]] = []
+        for g, ents in self._staged.items():
+            admitted = 0
+            if state[g] == _LEADER and term[g] == self.l_term[g]:
+                admitted = int(last[g] - self.l_last[g])
+            t = int(term[g])
+            for j, items in enumerate(ents):
+                if j < admitted:
+                    i = int(self.l_last[g]) + 1 + j
+                    payload = _pack_entry(items)
+                    self.payloads[(g, i, t)] = payload
+                    rec.entries.append((g, i, t, payload))
+                    fresh_frames.append((g, i, t, payload))
+                else:
+                    requeue.append((g, [it for e in ents[j:] for it in e]))
+                    break
+        with self._lock:
+            for g, rest in requeue:
+                self._pending[g].extendleft(reversed(rest))
+                self._dirty.add(g)
+        # Payloads learned from peers this round are journaled too: an ack
+        # we later issue from their application must survive OUR restart.
+        rec.entries.extend(self._fresh_payloads)
+
+        self.l_term, self.l_vote, self.l_commit = term, vote, commit
+        self.l_state, self.l_last, self.l_ring = state, last, ring
+        self.l_lead = lead
+
+        # -- 5. persist BEFORE the next dispatch (cross-host contract) ----
+        if not rec.is_empty():
+            self.wal.append(rec)
+            self._recent_recs.append(rec)
+
+        # -- 6. fan out fresh local admissions ----------------------------
+        if fresh_frames:
+            self.frames.broadcast({"t": "pay"}, _pack_payloads(fresh_frames))
+        self._fresh_payloads = []
+
+        # -- 7. apply + ack locally ---------------------------------------
+        self._apply_committed(trigger=True)
+        self._request_pulls()
+
+        self.round_no += 1
+        ms = (time.perf_counter() - t_round) * 1000.0
+        self.round_ms_ewma = (ms if self.round_ms_ewma == 0.0 else
+                              self.round_ms_ewma
+                              + 0.05 * (ms - self.round_ms_ewma))
+        if self.round_no % self.cfg.checkpoint_rounds == 0:
+            self._checkpoint()
+            self._gc_payloads()
+
+    # ------------------------------------------------------------------
+    # apply
+    # ------------------------------------------------------------------
+
+    def _apply_committed(self, trigger: bool, hist=None) -> None:
+        W = self.cfg.window
+        changed = np.nonzero(self.l_commit > self.applied)[0]
+        now = time.time()
+        for g in changed:
+            g = int(g)
+            lo, hi = int(self.applied[g]), int(self.l_commit[g])
+            done = lo
+            for i in range(lo + 1, hi + 1):
+                t = 0
+                if i > self.l_last[g] - W:
+                    t = int(self.l_ring[g, i % W])
+                if t == 0 and hist is not None:
+                    t = hist.get((g, i), 0)
+                if t == 0:
+                    log.error("host %d: no term for committed entry "
+                              "g=%d i=%d", self.my_slot, g, i)
+                    break
+                key = (g, i, t)
+                payload = self.payloads.get(key)
+                if payload is None:
+                    # Leader no-ops never ship payloads; real entries that
+                    # haven't arrived yet stall the cursor until a pull
+                    # repairs them. Heuristic: a no-op is index == the
+                    # first entry of its term from OUR ring; safer to stall
+                    # briefly and pull — peers answer no-op pulls with
+                    # nothing, and _maybe_noop resolves them.
+                    if self._maybe_noop(g, i, t):
+                        done = i
+                        continue
+                    self._missing.setdefault(key, now)
+                    break
+                if payload[0] == P_REQ:
+                    r = Request.decode(payload[1:])
+                    try:
+                        result = self._apply_request(g, r)
+                    except errors.EtcdError as err:
+                        result = err
+                    if trigger:
+                        if r.method != METHOD_SYNC:
+                            self.acked_requests += 1
+                        self.wait.trigger(r.id, result)
+                elif payload[0] == P_MULTI:
+                    for blob in _unpack_multi(payload):
+                        r = Request.decode(blob)
+                        try:
+                            result = self._apply_request(g, r)
+                        except errors.EtcdError as err:
+                            result = err
+                        if trigger:
+                            self.acked_requests += 1
+                            self.wait.trigger(r.id, result)
+                done = i
+            self.applied[g] = done
+
+    def _maybe_noop(self, g: int, i: int, t: int) -> bool:
+        """True if entry (g, i, term t) is a leader no-op: it is the FIRST
+        entry of term t in our log (leaders append exactly one payload-less
+        entry, at the start of their term — kernel _append_noop_and_lead)."""
+        W = self.cfg.window
+        if i - 1 >= 1 and i - 1 > self.l_last[g] - W:
+            prev_t = int(self.l_ring[g, (i - 1) % W])
+            return prev_t != 0 and prev_t < t
+        return i == 1
+
+    def _apply_request(self, g: int, r: Request):
+        st = self.store(g)
+        exp = r.expiration
+        if r.method == METHOD_POST:
+            return st.create(r.path, is_dir=r.dir, value=r.val, unique=True,
+                             expire_time=exp)
+        if r.method == METHOD_PUT:
+            if r.refresh:
+                return st.update(r.path, None, exp, refresh=True)
+            if r.prev_exist is not None:
+                if r.prev_exist:
+                    if r.prev_index or r.prev_value:
+                        return st.compare_and_swap(r.path, r.prev_value,
+                                                   r.prev_index, r.val, exp)
+                    return st.update(r.path, r.val, exp)
+                return st.create(r.path, is_dir=r.dir, value=r.val,
+                                 expire_time=exp)
+            if r.prev_index or r.prev_value:
+                return st.compare_and_swap(r.path, r.prev_value,
+                                           r.prev_index, r.val, exp)
+            return st.set(r.path, is_dir=r.dir, value=r.val, expire_time=exp)
+        if r.method == METHOD_DELETE:
+            if r.prev_index or r.prev_value:
+                return st.compare_and_delete(r.path, r.prev_value,
+                                             r.prev_index)
+            return st.delete(r.path, is_dir=r.dir, recursive=r.recursive)
+        if r.method == METHOD_QGET:
+            return st.get(r.path, r.recursive, r.sorted)
+        if r.method == METHOD_SYNC:
+            st.delete_expired_keys(r.time)
+            return None
+        raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                               cause=f"bad method {r.method}")
+
+    def _request_pulls(self) -> None:
+        if not self._missing:
+            return
+        now = time.time()
+        if now - self._last_pull < self.cfg.pull_interval:
+            return
+        self._last_pull = now
+        wants = [list(k) for k, t0 in self._missing.items()
+                 if now - t0 >= self.cfg.pull_interval / 2]
+        if wants:
+            self.frames.broadcast({"t": "pull", "wants": wants[:512]})
+
+    # ------------------------------------------------------------------
+    # checkpoint
+    # ------------------------------------------------------------------
+
+    def _checkpoint(self) -> None:
+        import base64 as _b64
+        state = {
+            "round": self.round_no - 1,
+            "term": np_b64(self.l_term), "vote": np_b64(self.l_vote),
+            "commit": np_b64(self.l_commit), "last": np_b64(self.l_last),
+            "ring": np_b64(self.l_ring),
+            "applied": np_b64(self.applied),
+            "stores": {str(g): s.save().decode()
+                       for g, s in self._stores.items()},
+            "payloads": [
+                (g, i, t, _b64.b64encode(p).decode())
+                for (g, i, t), p in self.payloads.items()
+                if i > self.applied[g]],
+        }
+        self.wal.save_checkpoint(self.round_no - 1, state)
+
+    def _gc_payloads(self) -> None:
+        dead = [k for k in self.payloads if k[1] <= self.applied[k[0]]]
+        for k in dead:
+            del self.payloads[k]
+
+
+# ---------------------------------------------------------------------------
+# frame payload packing
+# ---------------------------------------------------------------------------
+
+def _pack_items(items: List[Tuple[int, bytes]]) -> bytes:
+    out = [struct.pack("<I", len(items))]
+    for rid, payload in items:
+        out.append(struct.pack("<QI", rid, len(payload)))
+        out.append(payload)
+    return b"".join(out)
+
+
+def _unpack_items(blob: bytes) -> List[Tuple[int, bytes]]:
+    (n,) = struct.unpack_from("<I", blob, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        rid, ln = struct.unpack_from("<QI", blob, off)
+        off += 12
+        out.append((rid, blob[off:off + ln]))
+        off += ln
+    return out
+
+
+def _pack_payloads(entries: List[Tuple[int, int, int, bytes]]) -> bytes:
+    out = [struct.pack("<I", len(entries))]
+    for g, i, t, payload in entries:
+        out.append(struct.pack("<IIII", g, i, t, len(payload)))
+        out.append(payload)
+    return b"".join(out)
+
+
+def _unpack_payloads(blob: bytes) -> List[Tuple[int, int, int, bytes]]:
+    (n,) = struct.unpack_from("<I", blob, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        g, i, t, ln = struct.unpack_from("<IIII", blob, off)
+        off += 16
+        out.append((g, i, t, blob[off:off + ln]))
+        off += ln
+    return out

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Launch one rank of the MULTI-HOST MultiEngine on localhost — N OS
+processes, each contributing one CPU device to a global ("groups",
+"peers") mesh and owning one peer-slot column of every tenant group
+(server/hostengine.py). Consensus rides the kernel's cross-process
+all_to_all (gloo); proposals/payloads ride the frame transport; each rank
+serves the tenant HTTP API and journals its own WAL shard.
+
+Rank mode (driven by tests or an external supervisor):
+    MHE_RANK=0 MHE_NHOSTS=3 MHE_COORD=127.0.0.1:p \
+    MHE_DATA=/dir MHE_HTTP_PORTS=a,b,c MHE_FRAME_PORTS=d,e,f \
+    MHE_GROUPS=8 python scripts/multihost_engine.py
+
+Standalone demo (spawns its own 3 ranks, serves until Ctrl-C):
+    python scripts/multihost_engine.py
+"""
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_rank() -> int:
+    rank = int(os.environ["MHE_RANK"])
+    n = int(os.environ["MHE_NHOSTS"])
+    coord = os.environ["MHE_COORD"]
+    data = os.environ["MHE_DATA"]
+    http_ports = [int(p) for p in os.environ["MHE_HTTP_PORTS"].split(",")]
+    frame_ports = [int(p) for p in os.environ["MHE_FRAME_PORTS"].split(",")]
+    groups = int(os.environ.get("MHE_GROUPS", "8"))
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from etcd_tpu.utils.platform import enable_compile_cache
+    enable_compile_cache()
+    print(f"rank {rank}: joining distributed ({coord})", flush=True)
+    jax.distributed.initialize(coordinator_address=coord, num_processes=n,
+                               process_id=rank)
+
+    from etcd_tpu.etcdhttp.tenants import EngineHttp
+    from etcd_tpu.server.hostengine import HostEngine, HostEngineConfig
+
+    cfg = HostEngineConfig(
+        groups=groups, peers=n,
+        data_dir=os.path.join(data, f"host{rank}"),
+        host_id=rank,
+        frame_listen=("127.0.0.1", frame_ports[rank]),
+        frame_peers={h: ("127.0.0.1", frame_ports[h]) for h in range(n)},
+        fsync=os.environ.get("MHE_FSYNC", "1") == "1",
+        request_timeout=float(os.environ.get("MHE_REQ_TIMEOUT", "20")),
+        round_interval=float(os.environ.get("MHE_ROUND_INTERVAL", "0")),
+    )
+    eng = HostEngine(cfg)
+    http = EngineHttp(eng, port=http_ports[rank])
+    eng.start()
+    http.start()
+    print(f"rank {rank}: serving tenants on {http.url} "
+          f"(frames :{frame_ports[rank]})", flush=True)
+
+    stop = {"flag": False}
+
+    def on_term(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    import time
+    while not stop["flag"] and not eng._stop_ev.is_set():
+        time.sleep(0.2)
+    http.stop()
+    eng.stop()
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — peers may already be gone
+        pass
+    return 0 if eng.failed is None else 1
+
+
+def spawn_all(n: int = 3) -> int:
+    import socket
+    import subprocess
+    import tempfile
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    coord = f"127.0.0.1:{free_port()}"
+    http_ports = [free_port() for _ in range(n)]
+    frame_ports = [free_port() for _ in range(n)]
+    data = tempfile.mkdtemp(prefix="mhe-")
+    procs = []
+    for r in range(n):
+        env = dict(os.environ, MHE_RANK=str(r), MHE_NHOSTS=str(n),
+                   MHE_COORD=coord, MHE_DATA=data,
+                   MHE_HTTP_PORTS=",".join(map(str, http_ports)),
+                   MHE_FRAME_PORTS=",".join(map(str, frame_ports)))
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen([sys.executable,
+                                       os.path.abspath(__file__)], env=env))
+    print(f"{n} ranks up; HTTP ports {http_ports}; data {data}")
+    try:
+        for p in procs:
+            p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    if "MHE_RANK" in os.environ:
+        sys.exit(run_rank())
+    sys.exit(spawn_all())
