@@ -44,6 +44,12 @@ class _TenantServer:
         self.cluster = _TenantCluster(g)
         self.clock = time.time
 
+    def cluster_version(self) -> str:
+        # All tenants of one engine run the binary's version — there is no
+        # per-tenant rolling upgrade, so the security capability gate
+        # (reference capability.go) is always open.
+        return version.VERSION
+
     def do(self, r):
         return self._engine.do(self._g, r)
 
@@ -70,6 +76,7 @@ class TenantAPI:
     def __init__(self, engine) -> None:
         self.engine = engine
         self._apis: Dict[int, ClientAPI] = {}
+        self._secs: Dict[int, object] = {}
 
     def install(self, router: Router) -> None:
         router.add("/tenants", self.handle_tenants_root, exact=True)
@@ -117,8 +124,20 @@ class TenantAPI:
     def _api(self, g: int) -> ClientAPI:
         api = self._apis.get(g)
         if api is None:
-            api = self._apis[g] = ClientAPI(_TenantServer(self.engine, g))
+            # Per-tenant auth: each tenant gets its own SecurityHandler
+            # whose users/roles/enabled flag live under /2/security/* of
+            # the TENANT's OWN replicated keyspace (the security.go:66-68
+            # doer seam bound to this group's consensus) — tenants enable
+            # and administer auth independently of each other.
+            from etcd_tpu.etcdhttp.client_security import SecurityHandler
+            srv = _TenantServer(self.engine, g)
+            sec = self._secs[g] = SecurityHandler(srv)
+            api = self._apis[g] = ClientAPI(srv, security=sec)
         return api
+
+    def _sec(self, g: int):
+        self._api(g)
+        return self._secs[g]
 
     def handle_tenants(self, ctx: Ctx, suffix: str) -> None:
         parts = suffix.split("/", 1)
@@ -141,6 +160,11 @@ class TenantAPI:
                     ctx.send(e.status_code, e.to_json().encode() + b"\n",
                              "application/json")
                     return
+                # Drop the cached per-tenant handlers: a recycled pool
+                # slot must get a FRESH SecurityStore (the old one's
+                # ensured-dirs state refers to the dropped keyspace).
+                self._apis.pop(g, None)
+                self._secs.pop(g, None)
                 ctx.send_json(200, {"removed": g})
             elif ctx.method == "GET":
                 if self.engine.tenant_active(g):
@@ -156,6 +180,10 @@ class TenantAPI:
             return
         if rest == "v2/keys" or rest.startswith("v2/keys/"):
             self._api(g).handle_keys(ctx, rest[len("v2/keys"):])
+        elif rest == "v2/security" or rest.startswith("v2/security/"):
+            self._handle_security(ctx, g, rest[len("v2/security"):])
+        elif rest.startswith("v2/stats/"):
+            self._handle_stats(ctx, g, rest[len("v2/stats/"):])
         elif rest == "status":
             ctx.send_json(200, self.engine.status(g))
         elif rest == "conf":
@@ -163,9 +191,64 @@ class TenantAPI:
         else:
             ctx.send_json(404, {"message": f"unknown tenant path {rest!r}"})
 
+    def _handle_security(self, ctx: Ctx, g: int, sub: str) -> None:
+        """Per-tenant /v2/security/{roles,users,enable} (reference
+        client_security.go routes, one instance per tenant group)."""
+        sec = self._sec(g)
+        if sub == "/enable":
+            sec.handle_enable(ctx, "")
+        elif sub == "/roles" or sub.startswith("/roles/"):
+            sec.handle_roles(ctx, sub[len("/roles"):])
+        elif sub == "/users" or sub.startswith("/users/"):
+            sec.handle_users(ctx, sub[len("/users"):])
+        else:
+            ctx.send_json(404, {"message": f"unknown security path {sub!r}"})
+
+    def _handle_stats(self, ctx: Ctx, g: int, which: str) -> None:
+        """Per-tenant /v2/stats/{store,self,leader} (reference stats/
+        payloads; self/leader report the tenant's consensus view from the
+        engine — there is no per-tenant network transport to meter)."""
+        eng = self.engine
+        if which == "store":
+            ctx.send_json(200, eng.store(g).json_stats())
+            return
+        lead = eng.leader_slot(g)
+        st = eng.status(g)
+        if which == "self":
+            ctx.send_json(200, {
+                "name": f"tenant{g}",
+                "id": f"{g:x}",
+                "state": ("StateLeader" if lead == 0 else "StateFollower"),
+                "leaderInfo": {"leader": f"{lead:x}" if lead >= 0 else ""},
+                "raftTerm": st["term"],
+                "raftIndex": st["commit"],
+                "appliedIndex": st["applied"],
+            })
+        elif which == "leader":
+            if lead < 0:
+                # Mid-election: the reference answers 403 from non-leaders
+                # rather than fabricating a leader id.
+                ctx.send_json(403, {"message": "not current leader"})
+                return
+            followers = {f"{s:x}": {"counts": {"fail": 0, "success":
+                                               st["applied"]},
+                         "latency": {}}
+                         for s in st["active_slots"] if s != lead}
+            ctx.send_json(200, {"leader": f"{lead:x}",
+                                "followers": followers})
+        else:
+            ctx.send_json(404, {"message": f"unknown stats path {which!r}"})
+
     def _handle_conf(self, ctx: Ctx, g: int) -> None:
         if ctx.method != "POST":
             ctx.send(405, b"Method Not Allowed", headers={"Allow": "POST"})
+            return
+        # Membership mutation needs root once the TENANT's security is on
+        # (reference /v2/members root gate, client.go:184-187) — without
+        # this, an unauthenticated client could shrink an authenticated
+        # tenant's quorum.
+        if not self._sec(g).check_members_access(ctx):
+            ctx.send_json(401, {"message": "Insufficient credentials"})
             return
         try:
             d = json.loads(ctx.body.decode() or "{}")

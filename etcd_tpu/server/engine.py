@@ -375,7 +375,7 @@ class MultiEngine:
             self.applied = pool_pad(b64_np(ckpt["applied"])
                                     .astype(np.int64))
             for g_s, blob in ckpt["stores"].items():
-                st = Store()
+                st = Store(namespaces=("/0", "/1"))
                 st.recovery(blob.encode())
                 self._stores[int(g_s)] = st
             for g, i, t, b64p in ckpt["payloads"]:
@@ -530,10 +530,13 @@ class MultiEngine:
             # Lock: HTTP handler threads race the engine apply thread on
             # first touch of a tenant; an unsynchronized check-then-set
             # could discard a Store already holding applied writes.
+            # Namespaces match the classic server's store (reference
+            # store.New(StoreClusterPrefix, StoreKeysPrefix)) so an empty
+            # tenant serves GET /v2/keys/ identically.
             with self._lock:
                 s = self._stores.get(g)
                 if s is None:
-                    s = self._stores[g] = Store()
+                    s = self._stores[g] = Store(namespaces=("/0", "/1"))
         return s
 
     def leader_slot(self, g: int) -> int:

@@ -236,7 +236,7 @@ class HostEngine:
             self.l_ring = b64_np(ckpt["ring"]).astype(np.int32)
             self.applied = b64_np(ckpt["applied"]).astype(np.int64)
             for g_s, blob in ckpt["stores"].items():
-                st = Store()
+                st = Store(namespaces=("/0", "/1"))
                 st.recovery(blob.encode())
                 self._stores[int(g_s)] = st
             import base64 as _b64
@@ -385,7 +385,7 @@ class HostEngine:
             with self._lock:
                 s = self._stores.get(g)
                 if s is None:
-                    s = self._stores[g] = Store()
+                    s = self._stores[g] = Store(namespaces=("/0", "/1"))
         return s
 
     def leader_slot(self, g: int) -> int:
