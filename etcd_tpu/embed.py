@@ -35,6 +35,7 @@ class EtcdConfig:
     advertise_client_urls: Sequence[str] = ()
     cluster_token: str = "etcd-cluster"
     snap_count: int = 10000
+    catch_up_entries: int = 5000   # log kept behind a snapshot (raft.go:38)
     tick_ms: int = 100
     election_ticks: int = 10
     request_timeout: float = 5.0
@@ -71,6 +72,7 @@ class Etcd:
             cluster_token=cfg.cluster_token,
             client_urls=tuple(cfg.advertise_client_urls) or client_urls,
             snap_count=cfg.snap_count, tick_ms=cfg.tick_ms,
+            catch_up_entries=cfg.catch_up_entries,
             election_ticks=cfg.election_ticks,
             request_timeout=cfg.request_timeout,
             new_cluster=cfg.initial_cluster_state != "existing",

@@ -57,6 +57,14 @@ class V3API:
             self._err(ctx, 500, 13,
                       "member failed (fatal apply error); restart required")
             return
+        if getattr(self.server, "v3_gapped", False):
+            # A legacy snapshot (no v3 image) outran this member's v3
+            # backend: its keyspace has a hole and would serve forked
+            # data — refuse everything, including serializable reads.
+            self._err(ctx, 503, 14,
+                      "v3 keyspace gapped by snapshot install; member "
+                      "resync required")
+            return
         # v2 auth has no v3 user model, so when security is enabled the
         # whole v3 preview surface requires root credentials — the same
         # listener must not offer an unauthenticated write path (the

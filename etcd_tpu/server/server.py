@@ -17,8 +17,10 @@ prescribed ordering contract (reference raft/doc.go:28-55): WAL fsync of
 from __future__ import annotations
 
 import json
+import logging
 import os
 import queue
+import struct
 import threading
 import time
 from dataclasses import dataclass, field
@@ -48,12 +50,34 @@ from etcd_tpu.utils.wait import Wait
 from etcd_tpu.wal import WAL, WalSnapshot, wal_exists
 from etcd_tpu.wal import wal as wal_mod
 
+log = logging.getLogger("etcd_tpu.server")
+
 DEFAULT_SNAP_COUNT = 10000       # reference server.go:56
 CATCH_UP_ENTRIES = 5000          # reference etcdserver/raft.go:38
 MAX_WAL_FILES = 5                # reference -max-wals default
 MAX_SNAP_FILES = 5
 
 _MEMBER_ATTR_SUFFIX = "/attributes"
+
+# Snapshot payload envelope carrying BOTH state machines. Legacy snapshots
+# (and the reference's) are bare v2-store JSON — the magic disambiguates:
+# JSON can never start with these bytes. v3's consistent index travels
+# inside the sqlite image itself.
+_SNAP_MAGIC = b"\x00etcdtpu-snap-v3\x00"
+_SNAP_HDR = struct.Struct("<QQ")
+
+
+def _encode_snap_data(v2: bytes, v3: bytes) -> bytes:
+    return _SNAP_MAGIC + _SNAP_HDR.pack(len(v2), len(v3)) + v2 + v3
+
+
+def _decode_snap_data(data: bytes):
+    """-> (v2_json, v3_image_or_None)."""
+    if not data.startswith(_SNAP_MAGIC):
+        return data, None
+    l2, l3 = _SNAP_HDR.unpack_from(data, len(_SNAP_MAGIC))
+    off = len(_SNAP_MAGIC) + _SNAP_HDR.size
+    return data[off:off + l2], data[off + l2:off + l2 + l3]
 
 
 @dataclass
@@ -118,6 +142,9 @@ class EtcdServer:
         touch_dir_all(os.path.join(cfg.data_dir, "member", "v3"))
         self.v3 = V3Applier(
             os.path.join(cfg.data_dir, "member", "v3", "kv.db"))
+        # Set when a LEGACY snapshot (no v3 image) installed past the v3
+        # consistent index: the v3 keyspace has a gap and must not serve.
+        self.v3_gapped = False
         self._applied = 0
         self._snapi = 0
         self.wait = Wait()
@@ -245,7 +272,14 @@ class EtcdServer:
         if snap is not None:
             walsnap = WalSnapshot(index=snap.metadata.index,
                                   term=snap.metadata.term)
-            self.store.recovery(snap.data)
+            v2, v3img = _decode_snap_data(snap.data)
+            self.store.recovery(v2)
+            # The local v3 backend is usually AT or PAST the snapshot (it
+            # persists independently); only install the snapshot's image
+            # when the backend is behind it (lost/stale db file) — WAL
+            # replay then idempotently reapplies from the image forward.
+            if self.v3.consistent_index < snap.metadata.index:
+                self._install_v3_from_snap(v3img, snap.metadata.index)
             self.raft_storage.apply_snapshot(snap)
             self._applied = snap.metadata.index
             self._snapi = snap.metadata.index
@@ -725,15 +759,34 @@ class EtcdServer:
             self._maybe_snapshot()
 
     def _recover_from_snapshot(self, snap: Snapshot) -> None:
-        """A MsgSnap overtook our log: reset the state machine from the
-        leader's snapshot (reference server.go:429-453)."""
-        self.store.recovery(snap.data)
+        """A MsgSnap overtook our log: reset BOTH state machines from the
+        leader's snapshot (reference server.go:429-453; the v3 backend
+        image rides the same payload)."""
+        v2, v3img = _decode_snap_data(snap.data)
+        self.store.recovery(v2)
+        self._install_v3_from_snap(v3img, snap.metadata.index)
         self.cluster.recover()
         self._applied = snap.metadata.index
         self._snapi = snap.metadata.index
         for m in self.cluster.members():
             if m.id != self.id:
                 self.transport.add_peer(m.id, m.peer_urls)
+
+    def _install_v3_from_snap(self, v3img: Optional[bytes],
+                              snap_index: int) -> None:
+        if v3img is not None:
+            self.v3.install_snapshot(v3img)
+            self.v3_gapped = False
+        elif snap_index > self.v3.consistent_index:
+            # Legacy snapshot without a v3 image: entries in
+            # (consistent_index, snap_index] are compacted away, so this
+            # member's v3 keyspace has silently forked — REFUSE v3 service
+            # (incl. serializable reads) instead of serving diverged data.
+            self.v3_gapped = True
+            log.error("snapshot at index %d outran the v3 backend "
+                      "(consistent index %d) and carries no v3 image: "
+                      "v3 service DISABLED on this member until resync",
+                      snap_index, self.v3.consistent_index)
 
     def _apply_entries(self, ents: Sequence[Entry]) -> None:
         for e in ents:
@@ -864,8 +917,8 @@ class EtcdServer:
         # index) must be durable FIRST — otherwise a crash inside the
         # batch interval loses v3 ops in (consistentIndex, snapshot] with
         # no replay to recover them.
-        self.v3.kv.b.force_commit()
-        data = self.store.save()
+        data = _encode_snap_data(self.store.save(),
+                                 self.v3.snapshot_state())
         cs = ConfState(nodes=tuple(self.node.raft.nodes()))
         snap = self.raft_storage.create_snapshot(self._applied, cs, data)
         self.storage.save_snap(snap)

@@ -202,19 +202,14 @@ class V3Applier:
 
     def __init__(self, path: str) -> None:
         import threading
+        self._path = path
         self.kv = KVStore(path)
-        self.consistent_index = 0
-        with self.kv.b.batch_tx as tx:
-            _, vs = tx.unsafe_range(META_BUCKET, CONSISTENT_INDEX_KEY)
-        if vs:
-            self.consistent_index = struct.unpack(">Q", vs[0])[0]
         # Watch hub (the RFC's WatchRange): _published_rev is the fence
         # between historical replay (read from the backend) and live
         # publishes — a watcher registering mid-apply must not see the
         # in-flight revision twice or miss it.
         self._watch_lock = threading.Lock()
         self._watchers: List[V3Watcher] = []
-        self._published_rev = self.kv.current_rev.main
         # Leases (RFC LeaseCreate/Revoke/Attach/KeepAlive): replicated
         # state carries NO clocks — only a renewal sequence number bumped
         # by create/keepalive. The leader alone maps seq transitions to
@@ -226,14 +221,61 @@ class V3Applier:
         # deadlines on the new leader's clock (leases extend, never
         # silently shorten — etcd's behavior).
         self._lease_lock = threading.Lock()
-        self.leases: Dict[int, dict] = {}
-        with self.kv.b.batch_tx as tx:
-            tx.unsafe_create_bucket(LEASE_BUCKET)
-            lkeys, lvals = tx.unsafe_range(LEASE_BUCKET, b"",
-                                           b"\xff" * 9)
+        self._load_from_backend()
+
+    def _load_from_backend(self) -> None:
+        """(Re)load backend-derived state: consistent index, publish fence,
+        lease records. Called at boot and after a snapshot install."""
         import json as _json
-        for kb, vb in zip(lkeys, lvals):
-            self.leases[struct.unpack(">Q", kb)[0]] = _json.loads(vb)
+        self.consistent_index = 0
+        with self.kv.b.batch_tx as tx:
+            _, vs = tx.unsafe_range(META_BUCKET, CONSISTENT_INDEX_KEY)
+        if vs:
+            self.consistent_index = struct.unpack(">Q", vs[0])[0]
+        self._published_rev = self.kv.current_rev.main
+        with self._lease_lock:
+            self.leases = {}
+            with self.kv.b.batch_tx as tx:
+                tx.unsafe_create_bucket(LEASE_BUCKET)
+                lkeys, lvals = tx.unsafe_range(LEASE_BUCKET, b"",
+                                               b"\xff" * 9)
+            for kb, vb in zip(lkeys, lvals):
+                self.leases[struct.unpack(">Q", kb)[0]] = _json.loads(vb)
+
+    # -- snapshot integration (closes the v2-snapshot/v3-keyspace hole) ----
+
+    def snapshot_state(self) -> bytes:
+        """A point-in-time image of the ENTIRE v3 backend (sqlite
+        serialization after force-committing the pending batch) — embedded
+        in the member snapshot so a follower that catches up via
+        snapshot-install receives the v3 keyspace at exactly the snapshot
+        index (consistent index included: it lives inside the image)."""
+        self.kv.b.force_commit()
+        with self.kv.b.batch_tx.lock:
+            return self.kv.b._conn.serialize()
+
+    def install_snapshot(self, blob: bytes) -> None:
+        """Replace this member's whole v3 backend with the leader's image:
+        close, atomically swap the db file (dropping sqlite sidecars),
+        reopen, rebuild the in-memory index and meta. Open watchers keep
+        their registration; their next events come from the installed
+        state's revision sequence (mirroring the v2 store's watcher
+        behavior across Recovery)."""
+        import os
+        self.kv.close()
+        for suf in ("-wal", "-shm"):
+            try:
+                os.unlink(self._path + suf)
+            except FileNotFoundError:
+                pass
+        tmp = self._path + ".install"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+        self.kv = KVStore(self._path)
+        self._load_from_backend()
 
     def close(self) -> None:
         self.kv.close()

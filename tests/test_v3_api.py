@@ -716,3 +716,133 @@ def test_v3_survives_member_restart(tmp_path):
         assert b["kvs"][0]["version"] == ver + 1
     finally:
         m2.stop()
+
+
+# ---------------------------------------------------------------------------
+# v3 keyspace rides member snapshots (VERDICT r2 item 7 / ADVICE medium)
+# ---------------------------------------------------------------------------
+
+def test_v3_survives_snapshot_catchup(tmp_path):
+    """A member that lags past log compaction catches up via MsgSnap and
+    must receive the v3 keyspace too (the snapshot payload now carries the
+    sqlite image + consistent index): ranges agree byte-identically across
+    members and watch replay works on the caught-up member."""
+    import time as _t
+
+    n = 3
+    ports = free_ports(2 * n)
+    peer_urls = {f"m{i}": [f"http://127.0.0.1:{ports[i]}"]
+                 for i in range(n)}
+
+    def mk(i):
+        return Etcd(EtcdConfig(
+            name=f"m{i}", data_dir=str(tmp_path / f"m{i}"),
+            initial_cluster=peer_urls,
+            listen_client_urls=[f"http://127.0.0.1:{ports[n + i]}"],
+            tick_ms=10, request_timeout=5.0,
+            snap_count=10, catch_up_entries=2))
+
+    members = [mk(i) for i in range(n)]
+    for m in members:
+        m.start()
+    assert all(m.wait_leader(10) for m in members)
+
+    def put(k, v, member=0):
+        st, _, body = req(
+            "POST", members[member].client_urls[0] + "/v3/kv/put",
+            json.dumps({"key": e(k), "value": e(v)}).encode(),
+            {"Content-Type": "application/json"})
+        assert st == 200, body
+        return body
+
+    def rng(member, k="a", end=None):
+        body = {"key": e(k)}
+        if end:
+            body["range_end"] = e(end)
+        st, _, r = req(
+            "POST", members[member].client_urls[0] + "/v3/kv/range",
+            json.dumps(body).encode(), {"Content-Type": "application/json"})
+        assert st == 200, r
+        return r
+
+    for i in range(5):
+        put(f"k{i:02d}", f"v{i}")
+    members[2].stop()
+
+    # Drive far past snap_count so every survivor snapshots + compacts
+    # beyond m2's position.
+    for i in range(5, 45):
+        put(f"k{i:02d}", f"v{i}")
+    deadline = _t.time() + 15
+    while _t.time() < deadline:
+        if all(m.server._snapi > 0 and
+               m.server.raft_storage.first_index() > 6
+               for m in (members[0], members[1])):
+            break
+        _t.sleep(0.05)
+    assert members[0].server.raft_storage.first_index() > 6, \
+        "log never compacted past the lagging member"
+
+    # Restart m2 on its old data dir: WAL replay covers its pre-stop
+    # position; the rest MUST arrive via snapshot-install (compacted).
+    members[2] = mk(2)
+    members[2].start()
+    want = rng(0, "k", "l")
+    deadline = _t.time() + 30
+    while _t.time() < deadline:
+        try:
+            got = rng(2, "k", "l")
+            if got.get("kvs") and len(got["kvs"]) == len(want["kvs"]):
+                break
+        except AssertionError:
+            pass
+        _t.sleep(0.2)
+    got = rng(2, "k", "l")
+    # Byte-identical: same keys, values, create/mod revisions, versions.
+    assert got["kvs"] == want["kvs"], (got, want)
+    assert got["header"]["revision"] == want["header"]["revision"]
+    # Consistent index advanced to cover the snapshot span.
+    assert (members[2].server.v3.consistent_index
+            >= members[0].server._snapi)
+    assert members[2].server.v3_gapped is False
+
+    # A new write replicates to the caught-up member and its watch REPLAY
+    # (from a pre-snapshot-install revision boundary) serves history from
+    # the installed backend.
+    put("k99", "fresh")
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        if rng(2, "k99").get("kvs"):
+            break
+        _t.sleep(0.1)
+    assert d(rng(2, "k99")["kvs"][0]["value"]) == "fresh"
+
+    for m in members:
+        m.stop()
+
+
+def test_v3_legacy_snapshot_gap_guard(tmp_path):
+    """ADVICE r2 medium: a snapshot WITHOUT a v3 image that outruns the v3
+    consistent index must flip the member into v3_gapped and the gateway
+    must refuse all v3 service (503 code 14) instead of serving forked
+    data."""
+    ports = free_ports(2)
+    m = Etcd(EtcdConfig(
+        name="m0", data_dir=str(tmp_path / "m0"),
+        initial_cluster={"m0": [f"http://127.0.0.1:{ports[0]}"]},
+        listen_client_urls=[f"http://127.0.0.1:{ports[1]}"],
+        tick_ms=10, request_timeout=5.0))
+    m.start()
+    assert m.wait_leader(10)
+    st, _, _ = req("POST", m.client_urls[0] + "/v3/kv/put",
+                   json.dumps({"key": e("a"), "value": e("1")}).encode(),
+                   {"Content-Type": "application/json"})
+    assert st == 200
+    # Simulate a legacy (v2-only) snapshot install far past the backend.
+    m.server._install_v3_from_snap(None, m.server.v3.consistent_index + 99)
+    assert m.server.v3_gapped is True
+    st, _, body = req("POST", m.client_urls[0] + "/v3/kv/range",
+                      json.dumps({"key": e("a")}).encode(),
+                      {"Content-Type": "application/json"})
+    assert st == 503 and body.get("code") == 14, (st, body)
+    m.stop()
