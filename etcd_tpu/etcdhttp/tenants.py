@@ -71,12 +71,26 @@ class _TenantServer:
 
 
 class TenantAPI:
-    """Router glue: dispatches /tenants/{g}/... to per-tenant ClientAPIs."""
+    """Router glue: dispatches /tenants/{g}/... to per-tenant ClientAPIs.
 
-    def __init__(self, engine) -> None:
+    `admin_credentials` is an optional ("user", "password") pair; when set,
+    every pool-wide lifecycle verb (POST /tenants, PUT/DELETE /tenants/{g})
+    requires matching HTTP basic auth — the engine-operator analogue of the
+    reference's root gate on /v2/members (client.go:184-187). Independent
+    of it, DELETE on a tenant whose OWN auth is enabled always requires
+    that tenant's root credentials: destroying an authenticated tenant's
+    keyspace is strictly stronger than shrinking its quorum, which is
+    already root-gated via /tenants/{g}/conf."""
+
+    def __init__(self, engine, admin_credentials=None) -> None:
         self.engine = engine
-        self._apis: Dict[int, ClientAPI] = {}
-        self._secs: Dict[int, object] = {}
+        self.admin_credentials = admin_credentials
+        # Caches keyed by the engine's per-slot lifecycle generation: a
+        # slot removed + recreated (via HTTP here, the engine API
+        # directly, or another frontend) must never be served through the
+        # previous generation's SecurityHandler/store adapters.
+        self._apis: Dict[int, tuple] = {}   # g -> (gen, ClientAPI)
+        self._secs: Dict[int, tuple] = {}   # g -> (gen, SecurityHandler)
 
     def install(self, router: Router) -> None:
         router.add("/tenants", self.handle_tenants_root, exact=True)
@@ -97,6 +111,9 @@ class TenantAPI:
         if ctx.method != "POST":
             ctx.send(405, b"Method Not Allowed",
                      headers={"Allow": "GET, POST"})
+            return
+        if not self._lifecycle_ok(ctx):
+            ctx.send_json(401, {"message": "Insufficient credentials"})
             return
         self._create(ctx, None)
 
@@ -122,22 +139,48 @@ class TenantAPI:
         ctx.send_json(201, {"tenant": gid, "active_slots": list(range(n))})
 
     def _api(self, g: int) -> ClientAPI:
-        api = self._apis.get(g)
-        if api is None:
-            # Per-tenant auth: each tenant gets its own SecurityHandler
-            # whose users/roles/enabled flag live under /2/security/* of
-            # the TENANT's OWN replicated keyspace (the security.go:66-68
-            # doer seam bound to this group's consensus) — tenants enable
-            # and administer auth independently of each other.
-            from etcd_tpu.etcdhttp.client_security import SecurityHandler
-            srv = _TenantServer(self.engine, g)
-            sec = self._secs[g] = SecurityHandler(srv)
-            api = self._apis[g] = ClientAPI(srv, security=sec)
+        gen = int(self.engine.tenant_gen[g])
+        hit = self._apis.get(g)
+        if hit is not None and hit[0] == gen:
+            return hit[1]
+        # Per-tenant auth: each tenant gets its own SecurityHandler
+        # whose users/roles/enabled flag live under /2/security/* of
+        # the TENANT's OWN replicated keyspace (the security.go:66-68
+        # doer seam bound to this group's consensus) — tenants enable
+        # and administer auth independently of each other.
+        from etcd_tpu.etcdhttp.client_security import SecurityHandler
+        srv = _TenantServer(self.engine, g)
+        sec = SecurityHandler(srv)
+        api = ClientAPI(srv, security=sec)
+        self._secs[g] = (gen, sec)
+        self._apis[g] = (gen, api)
         return api
 
     def _sec(self, g: int):
         self._api(g)
-        return self._secs[g]
+        return self._secs[g][1]
+
+    def _lifecycle_ok(self, ctx: Ctx, g=None) -> bool:
+        """Gate for pool lifecycle verbs (create/remove). Two principals
+        may act: the ENGINE OPERATOR (when the frontend was configured
+        with admin credentials) anywhere in the pool, and — for verbs
+        aimed at a live tenant — that tenant's OWN root (when the tenant
+        enabled auth). Without configured admin credentials, lifecycle is
+        open EXCEPT against tenants that enabled auth, which always
+        require their root (deleting an authenticated tenant's keyspace
+        is strictly stronger than the already-root-gated quorum shrink
+        on /tenants/{g}/conf)."""
+        from etcd_tpu.etcdhttp.client_security import basic_auth
+        if self.admin_credentials is not None:
+            if basic_auth(ctx) == tuple(self.admin_credentials):
+                return True
+            if g is not None and self.engine.tenant_active(g):
+                sec = self._sec(g)
+                return sec.enabled() and sec.has_root_access(ctx)
+            return False
+        if g is not None and self.engine.tenant_active(g):
+            return self._sec(g).check_members_access(ctx)
+        return True
 
     def handle_tenants(self, ctx: Ctx, suffix: str) -> None:
         parts = suffix.split("/", 1)
@@ -152,19 +195,26 @@ class TenantAPI:
         # Lifecycle verbs on the bare /tenants/{g} path.
         if rest == "":
             if ctx.method == "PUT":
+                if not self._lifecycle_ok(ctx, g):
+                    ctx.send_json(401,
+                                  {"message": "Insufficient credentials"})
+                    return
                 self._create(ctx, g)
             elif ctx.method == "DELETE":
+                if not self._lifecycle_ok(ctx, g):
+                    ctx.send_json(401,
+                                  {"message": "Insufficient credentials"})
+                    return
                 try:
                     self.engine.remove_tenant(g)
                 except errors.EtcdError as e:
                     ctx.send(e.status_code, e.to_json().encode() + b"\n",
                              "application/json")
                     return
-                # Drop the cached per-tenant handlers: a recycled pool
-                # slot must get a FRESH SecurityStore (the old one's
-                # ensured-dirs state refers to the dropped keyspace).
-                self._apis.pop(g, None)
-                self._secs.pop(g, None)
+                # No cache pop needed: remove_tenant bumped the slot's
+                # lifecycle generation, so the next _api(g) discards the
+                # stale handlers (popping here would race a concurrent
+                # request's freshly-rebuilt entry).
                 ctx.send_json(200, {"removed": g})
             elif ctx.method == "GET":
                 if self.engine.tenant_active(g):
@@ -289,10 +339,11 @@ class EngineHttp:
     """A listening HTTP front for a MultiEngine."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 cors=None, tls_context=None) -> None:
+                 cors=None, tls_context=None,
+                 admin_credentials=None) -> None:
         self.engine = engine
         router = Router()
-        self.api = TenantAPI(engine)
+        self.api = TenantAPI(engine, admin_credentials=admin_credentials)
         self.api.install(router)
         self.http = HttpServer(host, port, router, cors=cors,
                                tls_context=tls_context)

@@ -234,6 +234,11 @@ class MultiEngine:
         self._admin_q: deque = deque()
         self._admin_flips: List[Tuple[int, int, int]] = []
         self._admin_acks: List[threading.Event] = []
+        # Per-slot lifecycle generation: bumped on every create/remove so
+        # frontends can invalidate per-tenant caches (an HTTP layer that
+        # cached handlers for generation k must not serve a recycled slot's
+        # generation k+1 keyspace through them).
+        self.tenant_gen = np.zeros(G, np.int64)
 
         # Host mirrors of the last read-back device state.
         self.h_term = np.zeros((G, P), np.int32)
@@ -764,6 +769,7 @@ class MultiEngine:
     def _tenant_reset(self, g: int) -> None:
         """Drop all host-side state of a pool slot (store, payloads,
         apply cursor, queued proposals)."""
+        self.tenant_gen[g] += 1
         st = self._stores.pop(g, None)
         if st is not None:
             st.watcher_hub.clear()   # wake/close blocked watchers
@@ -902,6 +908,19 @@ class MultiEngine:
                     while (dq and len(cur) < B and dq[0][1]
                            and dq[0][1][0] == P_REQ):
                         cur.append(dq.popleft())
+                    if not cur:
+                        # Head is neither P_CONF nor P_REQ (empty or junk
+                        # tag): consume it or the group jams on count=0
+                        # entries forever; fail its waiter immediately
+                        # rather than letting the client ride out the
+                        # full request timeout.
+                        rid, junk = dq.popleft()
+                        log.error("engine: dropping untagged proposal "
+                                  "g=%d rid=%d len=%d", g, rid, len(junk))
+                        self.wait.trigger(rid, errors.EtcdError(
+                            errors.ECODE_RAFT_INTERNAL,
+                            cause="untagged proposal dropped"))
+                        continue
                     ents.append(cur)
                 if not dq:
                     self._dirty.discard(g)
@@ -1123,7 +1142,8 @@ class MultiEngine:
                         except errors.EtcdError as err:
                             result = err
                         if trigger:
-                            self.acked_requests += 1
+                            if r.method != METHOD_SYNC:  # engine-internal
+                                self.acked_requests += 1
                             self.wait.trigger(r.id, result)
                 elif payload[0] == P_CONF:
                     d = json.loads(payload[1:].decode())

@@ -422,6 +422,16 @@ class HostEngine:
                                      "mesh axis in multi-host mode")
 
     @property
+    def tenant_gen(self) -> np.ndarray:
+        # Fixed pool: slots are never recycled, so every tenant stays at
+        # lifecycle generation 0 (the TenantAPI cache key). Cached — this
+        # sits on the per-request path.
+        gen = getattr(self, "_tenant_gen0", None)
+        if gen is None:
+            gen = self._tenant_gen0 = np.zeros(self.cfg.groups, np.int64)
+        return gen
+
+    @property
     def h_commit(self) -> np.ndarray:
         return self.l_commit[:, None]
 
@@ -582,6 +592,16 @@ class HostEngine:
             log.warning("host %d: need_host(NH_SNAP) flags on %d groups "
                         "(cross-host snapshot install not implemented)",
                         self.my_slot, int((need_host != 0).sum()))
+            # Consume the flags: the kernel only ORs NH_* bits, so without
+            # a write-back one event would re-log every round forever and
+            # mask later flags. Each host zeroes ITS column shard (purely
+            # local data, no collective — mirrors the single-host
+            # _service_need_host clearing).
+            jax = self._jax
+            st = st._replace(need_host=jax.make_array_from_callback(
+                (G, Pn), self._st_sh.need_host,
+                lambda idx: np.zeros((G, 1), np.int32)))
+            self.st = st
 
         # -- 4. durable record for OUR column -----------------------------
         my = self.my_slot
@@ -716,7 +736,8 @@ class HostEngine:
                         except errors.EtcdError as err:
                             result = err
                         if trigger:
-                            self.acked_requests += 1
+                            if r.method != METHOD_SYNC:
+                                self.acked_requests += 1
                             self.wait.trigger(r.id, result)
                 done = i
             self.applied[g] = done

@@ -170,3 +170,102 @@ def test_tenant_auth_survives_restart(cluster, tmp_path):
     finally:
         http2.stop()
         eng2.stop()
+
+
+@pytest.fixture()
+def lifecycle_cluster(tmp_path):
+    """Fresh small engine for lifecycle-security tests (ADVICE r3 high:
+    unauthenticated tenant deletion), with an operator credential on the
+    HTTP frontend."""
+    eng = MultiEngine(EngineConfig(
+        groups=3, peers=3, data_dir=str(tmp_path / "e"), fsync=False,
+        request_timeout=30.0))
+    eng.start()
+    http = EngineHttp(eng, admin_credentials=("op", "opsecret"))
+    http.start()
+    assert eng.wait_leaders(60)
+    yield eng, http.url
+    http.stop()
+    eng.stop()
+
+
+def _enable_tenant_auth(base, g, root_pw="rpw"):
+    t = f"{base}/tenants/{g}"
+    st, body = _req("PUT", t + "/v2/security/users/root",
+                    json.dumps({"user": "root",
+                                "password": root_pw}).encode(), JH)
+    assert st == 201, body
+    st, _ = _req("PUT", t + "/v2/security/enable",
+                 headers=_auth("root", root_pw))
+    assert st == 200
+
+
+def test_tenant_delete_requires_credentials(lifecycle_cluster):
+    eng, base = lifecycle_cluster
+    _enable_tenant_auth(base, 1)
+
+    # Unauthenticated deletion of an auth-enabled tenant: refused.
+    st, _ = _req("DELETE", f"{base}/tenants/1")
+    assert st == 401
+    assert eng.tenant_active(1)
+    # Wrong credential: refused.
+    st, _ = _req("DELETE", f"{base}/tenants/1",
+                 headers=_auth("root", "WRONG"))
+    assert st == 401
+    # The tenant's own root may delete it.
+    st, body = _req("DELETE", f"{base}/tenants/1",
+                    headers=_auth("root", "rpw"))
+    assert st == 200 and body["removed"] == 1
+    assert not eng.tenant_active(1)
+
+    # With an operator credential configured, even an UNAUTHENTICATED
+    # tenant's lifecycle needs it.
+    st, _ = _req("DELETE", f"{base}/tenants/0")
+    assert st == 401
+    st, _ = _req("DELETE", f"{base}/tenants/0",
+                 headers=_auth("op", "opsecret"))
+    assert st == 200
+    # Create likewise.
+    st, _ = _req("PUT", f"{base}/tenants/0")
+    assert st == 401
+    st, _ = _req("PUT", f"{base}/tenants/0",
+                 headers=_auth("op", "opsecret"))
+    assert st == 201
+
+    # The operator credential also overrides a tenant root (pool-wide
+    # admin), so a lost tenant root cannot strand a slot.
+    _enable_tenant_auth(base, 2, root_pw="zzz")
+    st, _ = _req("DELETE", f"{base}/tenants/2",
+                 headers=_auth("op", "opsecret"))
+    assert st == 200
+
+
+def test_tenant_recreate_gets_fresh_security_state(lifecycle_cluster):
+    """ADVICE r3: per-tenant handler caches are keyed on the engine's
+    lifecycle generation — a slot removed and recreated VIA THE ENGINE
+    API (not HTTP DELETE) must not be served through the stale cached
+    SecurityHandler of the previous generation."""
+    eng, base = lifecycle_cluster
+    _enable_tenant_auth(base, 1)
+    # Restrict the auto-created permissive guest role to read-only so the
+    # enabled state is observable from an unauthenticated client.
+    st, _ = _req("PUT", f"{base}/tenants/1/v2/security/roles/guest",
+                 json.dumps({"role": "guest", "revoke": {"kv": {
+                     "read": [], "write": ["*"]}}}).encode(),
+                 {**JH, **_auth("root", "rpw")})
+    assert st == 200
+    st, _ = _req("PUT", f"{base}/tenants/1/v2/keys/x", b"value=1", FH)
+    assert st == 401   # guest writes refused; handler now cached
+
+    # Recycle the slot straight through the engine (bypasses the HTTP
+    # DELETE cache-invalidation path).
+    eng.remove_tenant(1)
+    eng.create_tenant(1)
+    assert eng.wait_leaders(60, groups=[1])
+
+    # The fresh generation has auth disabled: writes are open again and
+    # the security store is empty.
+    st, body = _req("GET", f"{base}/tenants/1/v2/security/enable")
+    assert st == 200 and body["enabled"] is False
+    st, _ = _req("PUT", f"{base}/tenants/1/v2/keys/x", b"value=1", FH)
+    assert st == 201
