@@ -871,29 +871,48 @@ def _step_body(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     return st, outbox
 
 
-@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=(1, 2))
 def step_routed_auto(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
                      prop_count: jax.Array, prop_slot: jax.Array,
-                     tick: jax.Array) -> Tuple[GroupState, jax.Array]:
+                     tick: jax.Array, drop_mask=None,
+                     hops: int = 1) -> Tuple[GroupState, jax.Array]:
     """step + route_local with on-device fast-path selection: quiescent
     rounds (the steady-state common case) skip the P sequential message
     passes. ONE compiled program; lax.cond executes exactly one branch at
-    runtime."""
-    active = active_mask(st)
-    quiet = _quiet_pred(st, cfg, inbox, active, tick)
+    runtime.
 
-    def fast(ops):
-        st, inbox, pc, ps, tick = ops
-        s, out = _step_body(cfg, st, inbox, pc, ps, tick, quiet=True)
-        return s, route_local(out)
+    `hops` chains that many message-phase+routing passes INSIDE the one
+    compiled program: proposals and the tick fire only on the first hop,
+    so `hops=H` is bit-identical to H successive 1-hop calls whose last
+    H-1 carry no proposals and no tick (tests/test_kernel.py pins this).
+    With hops=3 a proposal admitted on hop 0 is replicated (hop 0 send ->
+    hop 1 append+ack -> hop 2 commit) within ONE invocation — the
+    propose->commit pipeline collapses from 3 round-trips through the
+    host to one device program, which is what makes sub-round ack
+    latencies possible on the serving path. `drop_mask` (G, P_to, P_from,
+    1) int32, applied to the routed inbox after EVERY hop, keeps
+    fault-injection (partitions, message drops) hop-accurate."""
+    for h in range(hops):
+        pc = prop_count if h == 0 else jnp.zeros_like(prop_count)
+        tk = tick if h == 0 else jnp.asarray(False)
+        active = active_mask(st)
+        quiet = _quiet_pred(st, cfg, inbox, active, tk)
 
-    def full(ops):
-        st, inbox, pc, ps, tick = ops
-        s, out = _step_body(cfg, st, inbox, pc, ps, tick, quiet=False)
-        return s, route_local(out)
+        def fast(ops):
+            st, inbox, pc, ps, tick = ops
+            s, out = _step_body(cfg, st, inbox, pc, ps, tick, quiet=True)
+            return s, route_local(out)
 
-    return jax.lax.cond(quiet, fast, full,
-                        (st, inbox, prop_count, prop_slot, tick))
+        def full(ops):
+            st, inbox, pc, ps, tick = ops
+            s, out = _step_body(cfg, st, inbox, pc, ps, tick, quiet=False)
+            return s, route_local(out)
+
+        st, inbox = jax.lax.cond(quiet, fast, full,
+                                 (st, inbox, pc, prop_slot, tk))
+        if drop_mask is not None:
+            inbox = inbox * drop_mask
+    return st, inbox
 
 
 def route_local(outbox: jax.Array) -> jax.Array:

@@ -159,26 +159,56 @@ class Store:
     def set(self, node_path: str, is_dir: bool = False, value: str = "",
             expire_time: Optional[float] = None) -> Event:
         """Create-or-replace (reference store.go:152-206): replacing a file
-        returns prevNode."""
+        returns prevNode.
+
+        This is the apply loop's hottest store op (every engine PUT lands
+        here), so it is fused into ONE tree traversal with an in-place
+        node rewrite on the file-replaces-file path — semantically
+        identical to the reference's remove-then-create (createdIndex
+        resets), without the detach/alloc/attach churn."""
         with self._lock:
             try:
-                # Set on an existing dir with dir=True is a TTL update-style
-                # no-op create error in the reference; keep create semantics:
-                prev: Optional[Node] = None
-                try:
-                    prev = self._walk(normalize(node_path))
-                except errors.EtcdError as err:
-                    if err.code != errors.ECODE_KEY_NOT_FOUND:
-                        raise
+                path = normalize(node_path)
+                if path in self._readonly:
+                    raise errors.EtcdError(errors.ECODE_ROOT_RONLY,
+                                           cause="/",
+                                           index=self.current_index)
+                next_index = self.current_index + 1
+                dirname, name = posixpath.split(path)
+                parent = self._make_dirs(dirname, next_index)
+                existing = parent.children.get(name)
+                now = self.clock()
                 prev_ex = None
-                if prev is not None:
-                    prev_ex = prev.as_extern(self.clock(),
-                                             materialize_children=False)
-                e = self._internal_create(node_path, is_dir, value,
-                                          unique=False, replace=True,
-                                          action=ev.SET,
-                                          expire_time=expire_time)
-                e.prev_node = prev_ex
+                if existing is not None:
+                    if existing.is_dir:
+                        # set over a dir is not allowed (reference 102) —
+                        # with OR without dir=True.
+                        raise errors.EtcdError(errors.ECODE_NOT_FILE,
+                                               cause=path,
+                                               index=self.current_index)
+                    prev_ex = existing.as_extern(
+                        now, materialize_children=False)
+                if existing is not None and not is_dir:
+                    # In-place replace (the hot path): a SET is a brand-new
+                    # node in reference semantics, so BOTH indices move.
+                    n = existing
+                    n.value = value
+                    n.created_index = n.modified_index = next_index
+                    n.expire_time = expire_time
+                else:
+                    if existing is not None:
+                        existing.remove(False, False, None)
+                    n = Node(path, next_index, next_index, parent,
+                             value=None if is_dir else value, is_dir=is_dir,
+                             expire_time=expire_time)
+                    parent.add(n)
+                self.ttl_heap.push(n)
+                self.current_index = next_index
+                e = Event(ev.SET,
+                          node=n.as_extern(now,
+                                           materialize_children=False),
+                          prev_node=prev_ex, etcd_index=next_index)
+                self.watcher_hub.notify(e)
                 self.stats.inc("setsSuccess")
                 return e
             except errors.EtcdError:
@@ -502,8 +532,10 @@ class Store:
 
     def _make_dirs(self, dirname: str, index: int) -> Node:
         """Walk to `dirname`, creating missing intermediate dirs (reference
-        walk with checkDir): an existing FILE on the path is 104 NotDir."""
-        parts = [p for p in normalize(dirname).split("/") if p]
+        walk with checkDir): an existing FILE on the path is 104 NotDir.
+        `dirname` must already be normalized (both callers split a
+        normalized path)."""
+        parts = [p for p in dirname.split("/") if p]
         cur = self.root
         for p in parts:
             nxt = cur.children.get(p)

@@ -8,35 +8,32 @@ single run-loop thread.
 from __future__ import annotations
 
 import queue
-import threading
 from typing import Any, Dict, Optional
 
 
 class Wait:
+    """Lock-free on the hot path: CPython dict setdefault/pop are
+    GIL-atomic, and trigger() sits on the apply loop's per-request path
+    (profiled), so the registry rides the GIL instead of a Lock."""
+
     def __init__(self) -> None:
-        self._lock = threading.Lock()
         self._waiters: Dict[int, "queue.Queue[Any]"] = {}
 
     def register(self, wid: int) -> "queue.Queue[Any]":
-        with self._lock:
-            if wid in self._waiters:
-                raise ValueError(f"duplicate wait id {wid:x}")
-            q: "queue.Queue[Any]" = queue.Queue(maxsize=1)
-            self._waiters[wid] = q
-            return q
+        q: "queue.Queue[Any]" = queue.Queue(maxsize=1)
+        if self._waiters.setdefault(wid, q) is not q:
+            raise ValueError(f"duplicate wait id {wid:x}")
+        return q
 
     def trigger(self, wid: int, value: Any) -> bool:
-        with self._lock:
-            q = self._waiters.pop(wid, None)
+        q = self._waiters.pop(wid, None)
         if q is None:
             return False
         q.put(value)
         return True
 
     def is_registered(self, wid: int) -> bool:
-        with self._lock:
-            return wid in self._waiters
+        return wid in self._waiters
 
     def cancel(self, wid: int) -> None:
-        with self._lock:
-            self._waiters.pop(wid, None)
+        self._waiters.pop(wid, None)

@@ -83,3 +83,79 @@ def test_auto_matches_full_trajectory():
     # The fast path must actually have engaged (and not always).
     assert quiet_rounds > 100, quiet_rounds
     assert quiet_rounds < 260, quiet_rounds
+
+
+def test_multihop_equals_chained_single_hops():
+    """hops=H must be bit-identical to H successive 1-hop invocations
+    whose last H-1 carry no proposals and no tick — including under a
+    drop mask, which the multi-hop kernel applies after every internal
+    routing (the fault-injection contract)."""
+    G, P, H = 6, 5, 3
+    cfg = KernelConfig(groups=G, peers=P, window=8, max_ents=2,
+                       election_tick=10, heartbeat_tick=3)
+    rng = np.random.default_rng(11)
+
+    st_m = init_state(cfg, stagger=True)
+    st_s = init_state(cfg, stagger=True)
+    in_m = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+    in_s = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+    zero = jnp.zeros(G, jnp.int32)
+    false = jnp.asarray(False)
+
+    drop = None
+    for r in range(80):
+        if r == 30:
+            # Partition group 2's slot 1 (both directions).
+            m_to = np.ones((G, P, 1, 1), np.int32)
+            m_from = np.ones((G, 1, P, 1), np.int32)
+            m_to[2, 1] = 0
+            m_from[2, 0, 1] = 0
+            drop = jnp.asarray(m_to * m_from)
+        if r == 55:
+            drop = None
+
+        state = np.asarray(st_s.state)
+        has_lead = (state == LEADER).any(axis=1)
+        slots = jnp.asarray((state == LEADER).argmax(axis=1)
+                            .astype(np.int32))
+        pc = jnp.asarray(
+            (rng.integers(0, cfg.max_ents + 1, size=G)
+             * has_lead).astype(np.int32)) if r % 2 else zero
+
+        st_m, in_m = kernel.step_routed_auto(cfg, st_m, in_m, pc, slots,
+                                             jnp.asarray(True), drop, H)
+        for h in range(H):
+            st_s, in_s = kernel.step_routed_auto(
+                cfg, st_s, in_s, pc if h == 0 else zero, slots,
+                jnp.asarray(True) if h == 0 else false)
+            if drop is not None:
+                in_s = in_s * drop
+        _assert_same(st_m, st_s, in_m, in_s, r)
+
+    commit = np.asarray(st_m.commit)
+    assert (commit.max(axis=1) > 10).all(), commit
+
+
+def test_multihop_commits_proposal_in_one_round():
+    """With hops=3 a proposal staged at an established leader must be
+    COMMITTED by the same invocation's readback (the ack-latency
+    contract the engine's cfg.hops relies on)."""
+    G, P = 4, 5
+    cfg = KernelConfig(groups=G, peers=P, window=8, max_ents=2,
+                       election_tick=10, heartbeat_tick=3)
+    st = init_state(cfg, stagger=True)
+    inbox = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+    zero = jnp.zeros(G, jnp.int32)
+    # Let elections settle (multi-hop: one round does the whole exchange).
+    for _ in range(6):
+        st, inbox = kernel.step_routed_auto(cfg, st, inbox, zero, zero,
+                                            jnp.asarray(True), None, 3)
+    state = np.asarray(st.state)
+    assert ((state == LEADER).sum(axis=1) == 1).all()
+    slots = jnp.asarray((state == LEADER).argmax(axis=1).astype(np.int32))
+    commit0 = np.asarray(st.commit).max(axis=1)
+    st, inbox = kernel.step_routed_auto(cfg, st, inbox,
+                                        jnp.full(G, 2, jnp.int32), slots,
+                                        jnp.asarray(True), None, 3)
+    commit1 = np.asarray(st.commit).max(axis=1)
+    assert (commit1 >= commit0 + 2).all(), (commit0, commit1)
