@@ -113,6 +113,7 @@ def child_main() -> int:
 
     G = int(os.environ.get("BENCH_GROUPS", 100_000 if on_tpu else 8_192))
     P = int(os.environ.get("BENCH_PEERS", 5))
+    P0 = P   # frozen for the metric name (churn rebinds P to 7)
     rounds = int(os.environ.get("BENCH_ROUNDS", 300 if on_tpu else 60))
     warm = int(os.environ.get("BENCH_WARM_ROUNDS", 20 if on_tpu else 5))
 
@@ -460,6 +461,9 @@ def child_main() -> int:
 
         # Host-side per-round work is O(G) Python; size the tenant count
         # for the serving path rather than the raw-kernel batch axis.
+        # Peers pinned from env, NOT the child-scope P (the churn scenario
+        # rebinds that to 7 for BASELINE config 5).
+        P = int(os.environ.get("BENCH_PEERS", 5))
         G_e = int(os.environ.get("BENCH_ENGINE_GROUPS",
                                  min(G, 16384 if on_tpu else 2048)))
         E = 4
@@ -570,13 +574,14 @@ def child_main() -> int:
                     injected += want
                 eng.run_round()
                 rb += 1
+            t_b_end = time.time()   # before drain/stop/teardown skew it
             for _ in range(6):
                 eng.run_round()
             eng._drain_applies()
             eng.stop()
         # Discard phase-B warmup (first 20% of the window): the paced rate
         # needs a few rounds to reach steady state.
-        cut = t_b + 0.2 * (time.time() - t_b)
+        cut = t_b + 0.2 * (t_b_end - t_b)
         b_lats = [s.t1 - s.t0 for s in samples
                   if s.t1 is not None and s.t0 >= cut]
         s_lats = [s.t1 - s.t0 for s in sat_samples if s.t1 is not None]
@@ -621,7 +626,7 @@ def child_main() -> int:
         line)."""
         primary = results[order[0]]
         out = {
-            "metric": f"aggregate_commits_per_sec_{G}_groups_{P}_peers",
+            "metric": f"aggregate_commits_per_sec_{G}_groups_{P0}_peers",
             "value": primary["commits_per_sec"],
             "unit": "commits/s",
             "vs_baseline": round(primary["commits_per_sec"]
@@ -650,9 +655,29 @@ def child_main() -> int:
         elif sc == "zipf":
             res, st, inbox = measure_zipf(st, inbox, sc_deadline, rounds)
             results[sc] = res
+        elif sc == "churn":
+            # BASELINE config 5 runs churn at SEVEN peers (100k x 7):
+            # rebind the child-scope geometry the measure() closures read
+            # (late binding) and boot a fresh 7-peer state.
+            P = int(os.environ.get("BENCH_CHURN_PEERS", 7))
+            cfg = KernelConfig(groups=G, peers=P, window=16, max_ents=4,
+                               election_tick=10, heartbeat_tick=3)
+            st7 = init_state(cfg, stagger=True)
+            in7 = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+            for _ in range(8):
+                st7, in7 = kernel.step_routed_auto(cfg, st7, in7, zero,
+                                                   zero, jnp.asarray(True))
+                if ((np.asarray(st7.state) == LEADER).sum(axis=1)
+                        >= 1).all():
+                    break
+            full = jnp.full(G, cfg.max_ents, jnp.int32)
+            res, st7, in7 = measure(sc, st7, in7, sc_deadline, rounds)
+            res["peers"] = P
+            results[sc] = res
         else:
             res, st, inbox = measure(sc, st, inbox, sc_deadline, rounds)
             results[sc] = res
+        results[sc].setdefault("platform", devs[0].platform)
         emit(results)
     return 0
 
@@ -729,14 +754,33 @@ def main() -> int:
 
     budget = float(os.environ.get("BENCH_BUDGET_S", 480.0))
     t0 = time.time()
+    cpu_reserve = min(150.0, budget * 0.3)
 
-    # Attempt 1: ambient platform (real TPU under the driver). The child's
-    # internal deadline must undercut the parent's kill timeout so it always
-    # finishes printing before SIGKILL.
-    line = _run_child({"BENCH_BUDGET_S": str(budget * 0.6)},
-                      timeout_s=budget * 0.65)
+    # TPU attempts with a bounded retry loop: the axon tunnel's init hang
+    # is INTERMITTENT (r01 hung; r02/r03 tunnels were down all round), so
+    # a failed attempt — which the child's own 75s init watchdog turns
+    # into a fast rc=7 exit — is worth retrying while the budget holds a
+    # CPU-fallback reserve. A child that got far enough to stream ANY
+    # scenario line counts as success (its lines already reached stdout).
+    line = None
+    attempt = 0
+    while line is None and attempt < 4:
+        attempt += 1
+        left = budget - (time.time() - t0) - cpu_reserve
+        if left < 60:
+            break
+        child_budget = min(left, budget * 0.6)
+        log(f"TPU attempt {attempt} (budget {child_budget:.0f}s)")
+        t_a = time.time()
+        line = _run_child({"BENCH_BUDGET_S": str(child_budget)},
+                          timeout_s=child_budget + 15)
+        if line is None and time.time() - t_a > 120:
+            # Not an init hang — the attempt burned real time measuring
+            # and still failed; don't spend the rest of the budget
+            # repeating it.
+            break
 
-    # Attempt 2: forced-CPU fallback with the remaining budget.
+    # Forced-CPU fallback with whatever remains.
     if line is None:
         left = budget - (time.time() - t0) - 5.0
         if left > 20:

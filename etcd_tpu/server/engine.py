@@ -590,7 +590,13 @@ class MultiEngine:
                 self._apply_committed(trigger=True, view=view)
             except Exception as e:  # noqa: BLE001 — re-raised at the seam
                 log.exception("engine applier failed")
-                self._apply_exc = e
+                with self._apply_cv:
+                    self._apply_exc = e
+                    self._apply_cv.notify_all()
+                # HALT — consuming further views after a mid-span failure
+                # would re-apply and re-ack around the hole. The engine
+                # fail-stops at the next enqueue/drain, which re-raises.
+                return
             self.phase_s["apply"] = self.phase_s.get("apply", 0.0) + \
                 (time.perf_counter() - t0)
             with self._apply_cv:
@@ -616,10 +622,13 @@ class MultiEngine:
         applier also owns (stores, applied, payload GC)."""
         if self._apply_thread is not None:
             with self._apply_cv:
-                while self._apply_q:
+                while (self._apply_q and self._apply_exc is None
+                       and self._apply_thread.is_alive()):
                     self._apply_cv.notify_all()
                     self._apply_cv.wait(0.5)
         self._raise_apply_exc()
+        if self._apply_q and not self._apply_thread.is_alive():
+            raise RuntimeError("applier thread died with work queued")
 
     def _raise_apply_exc(self) -> None:
         if self._apply_exc is not None:
@@ -1305,6 +1314,11 @@ class MultiEngine:
                         self.wait.trigger(
                             d["id"],
                             [int(x) for x in np.nonzero(self.h_mask[g])[0]])
+                # Advance the cursor PER ENTRY, not at span end: if an
+                # apply raises mid-span, a retry (or post-mortem) must
+                # resume after the last applied entry, never re-apply it
+                # (duplicate watch events / double store mutations).
+                self.applied[g] = i
             self.applied[g] = hi
 
     def _apply_request(self, g: int, r: Request):

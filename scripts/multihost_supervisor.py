@@ -82,6 +82,7 @@ class Supervisor:
         coord = f"127.0.0.1:{free_port()}"
         self.generation += 1
         self.procs = []
+        self._logfs = []
         for r in range(self.n):
             env = dict(os.environ,
                        MHE_RANK=str(r), MHE_NHOSTS=str(self.n),
@@ -93,6 +94,7 @@ class Supervisor:
             log_path = os.path.join(
                 self.data, f"rank{r}.gen{self.generation}.log")
             logf = open(log_path, "ab")
+            self._logfs.append(logf)
             self.procs.append(subprocess.Popen(
                 [sys.executable, RANK_SCRIPT], env=env,
                 stdout=logf, stderr=subprocess.STDOUT))
@@ -110,6 +112,14 @@ class Supervisor:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 pass
+        # Close the dead generation's log handles — an unbounded-recovery
+        # supervisor must not leak N fds per restart.
+        for f in getattr(self, "_logfs", []):
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._logfs = []
 
     def wait_serving(self, deadline: float) -> bool:
         """All ranks answer /engine/status AND their round counters

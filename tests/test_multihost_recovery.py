@@ -1,0 +1,150 @@
+"""Automatic multi-host failure recovery (VERDICT r3 item 3): SIGKILL one
+of N=3 ranks mid-traffic and assert the SUPERVISOR — not the operator —
+detects the stalled job, restarts every rank, replays per-host WALs, and
+resumes service within a bounded, MEASURED time. The reference keeps
+quorate groups alive through member death (rafthttp/peer.go:156-165);
+the SPMD engine's availability story is detect-restart-replay with a
+recorded MTTR (scripts/multihost_supervisor.py).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUP = os.path.join(REPO, "scripts", "multihost_supervisor.py")
+
+# Recovery bound (seconds) from detection to all-ranks-serving: rank boot
+# is dominated by the jax import + gloo join + kernel compile (warm
+# persistent cache); generous for shared CI boxes.
+MTTR_BOUND_S = 150.0
+
+
+def _get(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _put(url, body, timeout=25.0):
+    req = urllib.request.Request(
+        url, body, {"Content-Type": "application/x-www-form-urlencoded"},
+        method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _read_status(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _dump_rank_logs(data_dir):
+    for name in sorted(os.listdir(data_dir)):
+        if name.startswith("rank") and name.endswith(".log"):
+            p = os.path.join(data_dir, name)
+            with open(p, errors="replace") as f:
+                tail = f.read()[-4000:]
+            print(f"\n===== {name} =====\n{tail}", file=sys.stderr)
+
+
+@pytest.mark.slow
+def test_supervisor_recovers_from_rank_sigkill(tmp_path):
+    data = str(tmp_path / "mhe")
+    os.makedirs(data)
+    status_path = os.path.join(data, "supervisor.json")
+    env = dict(os.environ, MHE_NHOSTS="3", MHE_GROUPS="4",
+               MHE_DATA=data, MHE_STATUS=status_path,
+               MHE_STALL_S="5.0", MHE_MAX_RECOVERIES="1",
+               PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    sup = subprocess.Popen([sys.executable, SUP], env=env)
+    try:
+        # -- wait for first healthy generation ---------------------------
+        deadline = time.time() + 240
+        st = None
+        while time.time() < deadline:
+            st = _read_status(status_path)
+            if st and st["state"] == "serving":
+                break
+            if sup.poll() is not None:
+                _dump_rank_logs(data)
+                pytest.fail(f"supervisor exited rc={sup.returncode} "
+                            f"during boot")
+            time.sleep(0.5)
+        else:
+            _dump_rank_logs(data)
+            pytest.fail("job never became healthy")
+        ports = st["http_ports"]
+
+        # -- acked writes through EVERY rank (leader + forwarded) --------
+        for g in range(4):
+            code, _ = _put(f"http://127.0.0.1:{ports[g % 3]}"
+                           f"/tenants/{g}/v2/keys/pre", f"value=v{g}"
+                           .encode())
+            assert code in (200, 201)
+
+        # -- SIGKILL one rank mid-job ------------------------------------
+        victim = st["pids"]["1"]
+        os.kill(victim, signal.SIGKILL)
+        t_kill = time.time()
+
+        # -- the supervisor must detect + restart WITHOUT intervention ---
+        deadline = time.time() + 300
+        rec = None
+        while time.time() < deadline:
+            st = _read_status(status_path)
+            if st and st["recoveries"]:
+                rec = st["recoveries"][0]
+                if st["state"] == "serving":
+                    break
+            if sup.poll() is not None and not (st and st["recoveries"]):
+                _dump_rank_logs(data)
+                pytest.fail(f"supervisor died (rc={sup.returncode}) "
+                            f"without recording a recovery")
+            time.sleep(0.5)
+        if rec is None or st["state"] != "serving":
+            _dump_rank_logs(data)
+            pytest.fail(f"no completed recovery (status={st})")
+
+        assert rec["ok"], rec
+        assert rec["total_s"] < MTTR_BOUND_S, rec
+        assert st["generation"] == 2
+        print(f"recovery: cause={rec['cause']} detect->killed "
+              f"{rec['detect_to_killed_s']}s restart {rec['restart_s']}s "
+              f"total {rec['total_s']}s", file=sys.stderr)
+
+        # -- every pre-crash acked write survived (per-host WAL replay) --
+        for g in range(4):
+            got = _get(f"http://127.0.0.1:{ports[0]}"
+                       f"/tenants/{g}/v2/keys/pre", timeout=25)
+            assert got["node"]["value"] == f"v{g}", (g, got)
+        # -- and the recovered job serves new writes ---------------------
+        for g in range(4):
+            code, _ = _put(f"http://127.0.0.1:{ports[(g + 1) % 3]}"
+                           f"/tenants/{g}/v2/keys/post", b"value=after")
+            assert code in (200, 201)
+    except Exception:
+        _dump_rank_logs(data)
+        raise
+    finally:
+        sup.terminate()
+        try:
+            sup.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            sup.kill()
+        # Belt and braces: no orphaned ranks.
+        st = _read_status(status_path)
+        if st:
+            for pid in st.get("pids", {}).values():
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
