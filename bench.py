@@ -192,7 +192,10 @@ def child_main() -> int:
         therefore which writes each committed entry carries — is exact,
         not assumed. The metric is committed client WRITES/s; entry
         commits are reported alongside."""
-        B = 128
+        # Writes-per-entry cap mirrors the engine's BYTE-capped group
+        # commit (EngineConfig.batch_bytes = 1MB, the reference's
+        # maxSizePerMsg): 256B values + JSON envelope ~= 300B/write.
+        B = min(4096, (1 << 20) // 300)
         slots_np = current_slots(st)
         slots = jnp.asarray(slots_np)
         zr = zipf_rates()

@@ -132,7 +132,12 @@ class EngineConfig:
     # max_ents*batch_max writes per round while the on-device ring stays
     # statically shaped (the Zipf-skew answer; the reference's analogue is
     # batching many Ready entries into one WAL fsync, wal.go:459-487).
-    batch_max: int = 128
+    # The REAL cap is bytes (batch_bytes, mirroring the reference's 1MB
+    # maxSizePerMsg, etcdserver/raft.go:48): a hot tenant's admission
+    # scales with its queue depth up to ~max_ents MB/round instead of
+    # pinning at a fixed request count.
+    batch_max: int = 4096
+    batch_bytes: int = 1 << 20
     round_interval: float = 0.0       # seconds between rounds (0 = flat out)
     ticks_per_round: int = 1          # logical clock rate
     stagger: bool = True              # deterministic fast first election
@@ -1015,8 +1020,11 @@ class MultiEngine:
                         ents.append([dq.popleft()])
                         continue
                     cur: List[Tuple[int, bytes]] = []
-                    while (dq and len(cur) < B and dq[0][1]
+                    nbytes = 0
+                    while (dq and len(cur) < B
+                           and nbytes < self.cfg.batch_bytes and dq[0][1]
                            and dq[0][1][0] == P_REQ):
+                        nbytes += len(dq[0][1])
                         cur.append(dq.popleft())
                     if not cur:
                         # Head is neither P_CONF nor P_REQ (empty or junk

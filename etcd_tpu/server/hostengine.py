@@ -83,7 +83,8 @@ class HostEngineConfig:
     fsync: bool = True
     checkpoint_rounds: int = 4096
     request_timeout: float = 10.0
-    batch_max: int = 128
+    batch_max: int = 4096
+    batch_bytes: int = 1 << 20   # reference maxSizePerMsg, raft.go:48
     round_interval: float = 0.0
     stagger: bool = True
     pull_interval: float = 0.25    # payload catch-up request pacing
@@ -523,8 +524,11 @@ class HostEngine:
                     ents: List[List[Tuple[int, bytes]]] = []
                     while dq and len(ents) < E:
                         cur: List[Tuple[int, bytes]] = []
-                        while (dq and len(cur) < B and dq[0][1]
-                               and dq[0][1][0] == P_REQ):
+                        nbytes = 0
+                        while (dq and len(cur) < B
+                               and nbytes < self.cfg.batch_bytes
+                               and dq[0][1] and dq[0][1][0] == P_REQ):
+                            nbytes += len(dq[0][1])
                             cur.append(dq.popleft())
                         if not cur:
                             dq.popleft()   # drop non-REQ junk defensively
