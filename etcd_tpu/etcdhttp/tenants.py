@@ -316,7 +316,7 @@ class TenantAPI:
         eng = self.engine
         leaders = sum(1 for g in range(eng.cfg.groups)
                       if eng.leader_slot(g) >= 0)
-        ctx.send_json(200, {
+        out = {
             "groups": eng.cfg.groups,
             "tenants_active": len(eng.tenants()),
             "peers": eng.cfg.peers,
@@ -326,7 +326,13 @@ class TenantAPI:
             "applied_total": int(eng.applied.sum()),
             "acked_requests": eng.acked_requests,
             "pending_payloads": len(eng.payloads),
-        })
+        }
+        # Multi-host engines expose their catch-up counters too.
+        for k in ("pulls_sent", "payloads_pulled", "pay_frames_dropped"):
+            v = getattr(eng, k, None)
+            if v is not None:
+                out[k] = v
+        ctx.send_json(200, out)
 
     def handle_health(self, ctx: Ctx, suffix: str) -> None:
         ctx.send_json(200, {"health": "true"})

@@ -88,6 +88,12 @@ class HostEngineConfig:
     round_interval: float = 0.0
     stagger: bool = True
     pull_interval: float = 0.25    # payload catch-up request pacing
+    # Fault injection (tests/chaos, reference rafthttp.Pausable analogue):
+    # drop this percentage of outgoing per-peer PAYLOAD fan-out frames,
+    # forcing the receiving hosts onto the PULL catch-up path. Seeded for
+    # reproducible soaks.
+    drop_pay_pct: float = 0.0
+    fault_seed: int = 0
 
 
 class HostEngine:
@@ -168,6 +174,12 @@ class HostEngine:
         self._missing: Dict[Tuple[int, int, int], float] = {}
         self._last_pull = 0.0
         self.unreachable: Dict[int, int] = {}
+        import random as _random
+        self._fault_rng = (_random.Random(cfg.fault_seed)
+                           if cfg.drop_pay_pct > 0 else None)
+        self.pay_frames_dropped = 0
+        self.pulls_sent = 0
+        self.payloads_pulled = 0
 
         self.frames = FrameTransport(
             cfg.host_id, cfg.frame_listen, cfg.frame_peers,
@@ -318,7 +330,10 @@ class HostEngine:
                      (tuple(w) for w in header.get("wants", []))
                      if (g, i, tt) in self.payloads]
             if haves:
-                self.frames.send(frm, {"t": "pay"},
+                # Tagged as a pull RESPONSE so the receiver's repair
+                # counter stays exact (a late ordinary fan-out clearing a
+                # _missing marker is not a pull repair).
+                self.frames.send(frm, {"t": "pay", "pull": 1},
                                  _pack_payloads(
                                      [(g, i, tt, self.payloads[(g, i, tt)])
                                       for g, i, tt in haves]))
@@ -353,6 +368,7 @@ class HostEngine:
                         self._pending[g].extend(items)
                         self._dirty.add(g)
                 elif t == "pay":
+                    is_pull_resp = bool(header.get("pull"))
                     for g, i, tt, payload in _unpack_payloads(blob):
                         if not 0 <= g < G:
                             raise ValueError(f"group {g} out of range")
@@ -360,7 +376,9 @@ class HostEngine:
                         if key not in self.payloads:
                             self.payloads[key] = payload
                             self._fresh_payloads.append((g, i, tt, payload))
-                        self._missing.pop(key, None)
+                        if (self._missing.pop(key, None) is not None
+                                and is_pull_resp):
+                            self.payloads_pulled += 1
             except Exception:  # noqa: BLE001 — drop the frame, keep serving
                 log.exception("bad frame from host %d dropped", frm)
 
@@ -670,7 +688,18 @@ class HostEngine:
 
         # -- 6. fan out fresh local admissions ----------------------------
         if fresh_frames:
-            self.frames.broadcast({"t": "pay"}, _pack_payloads(fresh_frames))
+            blob = _pack_payloads(fresh_frames)
+            if self._fault_rng is None:
+                self.frames.broadcast({"t": "pay"}, blob)
+            else:
+                # Seeded per-peer drops: the receiver's apply cursor
+                # stalls on the missing payload and repairs via PULL.
+                for h in self.frames.peers:
+                    if self._fault_rng.random() * 100 >= \
+                            self.cfg.drop_pay_pct:
+                        self.frames.send(h, {"t": "pay"}, blob)
+                    else:
+                        self.pay_frames_dropped += 1
         self._fresh_payloads = []
 
         # -- 7. apply + ack locally ---------------------------------------
@@ -800,6 +829,7 @@ class HostEngine:
         wants = [list(k) for k, t0 in self._missing.items()
                  if now - t0 >= self.cfg.pull_interval / 2]
         if wants:
+            self.pulls_sent += 1
             self.frames.broadcast({"t": "pull", "wants": wants[:512]})
 
     # ------------------------------------------------------------------

@@ -55,6 +55,8 @@ def run_rank() -> int:
         fsync=os.environ.get("MHE_FSYNC", "1") == "1",
         request_timeout=float(os.environ.get("MHE_REQ_TIMEOUT", "20")),
         round_interval=float(os.environ.get("MHE_ROUND_INTERVAL", "0")),
+        drop_pay_pct=float(os.environ.get("MHE_DROP_PAY_PCT", "0")),
+        fault_seed=int(os.environ.get("MHE_FAULT_SEED", "0")) + rank,
     )
     eng = HostEngine(cfg)
     http = EngineHttp(eng, port=http_ports[rank])

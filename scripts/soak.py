@@ -133,13 +133,93 @@ def soak_engine(n_seeds: int, meta_seed: int = 0) -> None:
     print(f"engine soak OK: {n_seeds} campaigns, zero acked writes lost")
 
 
+def soak_hostengine(n_seeds: int, meta_seed: int = 0) -> None:
+    """Multi-host campaigns: per seed, a 2-3 host cluster with SEEDED
+    payload-frame drops takes randomized writes via random hosts through
+    kill/restart cycles (one rank SIGKILLed mid-traffic, then whole-job
+    restart — the supervisor's recovery move, driven directly). Every
+    write acked by a host must be served by that host after every
+    restart; pull counters must show the catch-up path engaged."""
+    import tempfile
+
+    import numpy as np
+
+    from test_hostengine import Cluster, _get, _put
+
+    meta = np.random.RandomState(meta_seed)
+    for k in range(n_seeds):
+        seed = int(meta.randint(1, 1 << 30))
+        rng = np.random.RandomState(seed)
+        n_hosts = int(rng.choice([2, 3]))
+        groups = int(rng.choice([4, 6]))
+        drop = float(rng.choice([0, 30, 60]))
+        acked = {}
+        with tempfile.TemporaryDirectory() as d:
+            cl = Cluster(d, n=n_hosts, groups=groups,
+                         extra_env={"MHE_DROP_PAY_PCT": str(drop),
+                                    "MHE_FAULT_SEED": str(seed),
+                                    "MHE_REQ_TIMEOUT": "30"}).start()
+            try:
+                cl.wait_up()
+                saw_pulls = False
+                for cycle in range(2):
+                    for i in range(20):
+                        g = int(rng.randint(groups))
+                        h = int(rng.randint(n_hosts))
+                        key = f"s{seed % 997}c{cycle}i{i}"
+                        try:
+                            r = _put(cl.base(h), g, key, "v", timeout=35)
+                            if r["action"] == "set":
+                                acked[(g, key)] = h
+                        except Exception:  # noqa: BLE001 — timeouts legal
+                            pass
+                    # Counters reset with each generation: sample BEFORE
+                    # the kill.
+                    for h in range(n_hosts):
+                        try:
+                            if cl.status(h)["pulls_sent"] > 0:
+                                saw_pulls = True
+                        except Exception:  # noqa: BLE001
+                            pass
+                    # Kill ONE random rank mid-traffic, then whole-job
+                    # restart (the collective stalls — by design).
+                    victim = int(rng.randint(n_hosts))
+                    cl.procs[victim].kill()
+                    time.sleep(0.5)
+                    cl.kill_all()
+                    cl.start()
+                    cl.wait_up()
+                    time.sleep(1.0)
+                    lost = []
+                    for (g, key), h in acked.items():
+                        try:
+                            if (_get(cl.base(h), g, key, timeout=20)
+                                    ["node"]["value"] != "v"):
+                                lost.append(key)
+                        except Exception:  # noqa: BLE001
+                            lost.append(key)
+                    assert not lost, (f"seed {seed} cycle {cycle}: ACKED "
+                                      f"WRITES LOST {lost[:5]}")
+                if drop > 0:
+                    assert saw_pulls, "drops never exercised the pull path"
+            except Exception:
+                cl.dump_logs()
+                raise
+            finally:
+                cl.kill_all()
+        print(f"hostengine seed {seed}: {n_hosts} hosts, drop={drop}%, "
+              f"{len(acked)} acked, zero lost", flush=True)
+    print(f"hostengine soak OK: {n_seeds} campaigns, zero acked writes "
+          f"lost")
+
+
 def main() -> int:
     from etcd_tpu.utils.platform import enable_compile_cache, force_cpu
     force_cpu(8)
     enable_compile_cache()
     what = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if what not in ("kernel", "engine", "all"):
-        print(f"unknown soak {what!r}: use kernel|engine|all",
+    if what not in ("kernel", "engine", "hostengine", "all"):
+        print(f"unknown soak {what!r}: use kernel|engine|hostengine|all",
               file=sys.stderr)
         return 2
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 0
@@ -147,12 +227,15 @@ def main() -> int:
         soak_kernel(n or 200)
     elif what == "engine":
         soak_engine(n or 3)
+    elif what == "hostengine":
+        soak_hostengine(n or 2)
     else:
         # 'all' keeps per-soak defaults: an explicit count meant for the
         # ~0.3s kernel schedules must not launch that many multi-minute
         # engine campaigns.
         soak_kernel(n or 200)
         soak_engine(3)
+        soak_hostengine(2)
     return 0
 
 

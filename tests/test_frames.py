@@ -1,0 +1,211 @@
+"""Unit tests for the DCN frame transport (parallel/frames.py) — the
+multi-host engine's control plane. Mirrors the reference's transport unit
+tier (rafthttp/transport_test.go, pipeline_test.go): framing roundtrip,
+per-pair ordering, nonblocking drop + ReportUnreachable on overflow and
+on connection failure, background reconnect, and handler-fault isolation.
+"""
+import socket
+import threading
+import time
+
+from etcd_tpu.parallel.frames import _MAX_QUEUE, FrameTransport, wait_peers
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class Sink:
+    def __init__(self):
+        self.frames = []
+        self.cv = threading.Condition()
+        self.unreachable = []
+
+    def on_frame(self, frm, header, blob):
+        with self.cv:
+            self.frames.append((frm, header, blob))
+            self.cv.notify_all()
+
+    def report_unreachable(self, h):
+        self.unreachable.append(h)
+
+    def wait_n(self, n, timeout=10.0):
+        deadline = time.time() + timeout
+        with self.cv:
+            while len(self.frames) < n:
+                left = deadline - time.time()
+                if left <= 0:
+                    return False
+                self.cv.wait(left)
+        return True
+
+
+def make_pair():
+    p0, p1 = free_port(), free_port()
+    peers = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    s0, s1 = Sink(), Sink()
+    t0 = FrameTransport(0, peers[0], peers, s0.on_frame,
+                        s0.report_unreachable)
+    t1 = FrameTransport(1, peers[1], peers, s1.on_frame,
+                        s1.report_unreachable)
+    return (t0, s0), (t1, s1), peers
+
+
+def test_roundtrip_and_ordering():
+    (t0, s0), (t1, s1), _ = make_pair()
+    try:
+        assert wait_peers(t0) and wait_peers(t1)
+        for i in range(200):
+            t0.send(1, {"t": "x", "i": i}, bytes([i % 251]) * i)
+        assert s1.wait_n(200)
+        # Per-pair ordering holds (ONE stream per peer pair).
+        assert [h["i"] for (_, h, _) in s1.frames] == list(range(200))
+        # Blob integrity incl. the empty blob.
+        for (frm, h, blob) in s1.frames:
+            assert frm == 0
+            assert blob == bytes([h["i"] % 251]) * h["i"]
+        # And the reverse direction works on its own stream.
+        t1.send(0, {"t": "y"}, b"back")
+        assert s0.wait_n(1)
+        assert s0.frames[0] == (1, {"t": "y"}, b"back")
+    finally:
+        t0.stop()
+        t1.stop()
+
+
+def test_large_blob():
+    (t0, s0), (t1, s1), _ = make_pair()
+    try:
+        blob = bytes(range(256)) * 4096 * 4   # 4 MB
+        t0.send(1, {"t": "big"}, blob)
+        assert s1.wait_n(1, timeout=20)
+        assert s1.frames[0][2] == blob
+    finally:
+        t0.stop()
+        t1.stop()
+
+
+def test_send_to_unknown_or_self_is_noop():
+    (t0, s0), (t1, s1), _ = make_pair()
+    try:
+        t0.send(0, {"t": "self"})      # own id: filtered from peer map
+        t0.send(99, {"t": "ghost"})    # unknown peer
+        t0.send(1, {"t": "real"})
+        assert s1.wait_n(1)
+        assert [h["t"] for (_, h, _) in s1.frames] == ["real"]
+    finally:
+        t0.stop()
+        t1.stop()
+
+
+def test_unreachable_peer_reports_and_drops():
+    """A peer that never listens: sends must not block, the queue must
+    not grow unboundedly, and report_unreachable must fire (reference
+    peer.go:156-165 semantics)."""
+    dead = free_port()
+    peers = {0: ("127.0.0.1", free_port()), 1: ("127.0.0.1", dead)}
+    s0 = Sink()
+    t0 = FrameTransport(0, peers[0], peers, s0.on_frame,
+                        s0.report_unreachable)
+    try:
+        todo = _MAX_QUEUE + 500
+        t_start = time.time()
+        for i in range(todo):
+            t0.send(1, {"i": i})
+        assert time.time() - t_start < 5.0, "send() blocked"
+        assert len(t0._qs[1]) <= _MAX_QUEUE
+        deadline = time.time() + 10
+        while not s0.unreachable and time.time() < deadline:
+            time.sleep(0.05)
+        assert 1 in s0.unreachable
+    finally:
+        t0.stop()
+
+
+def test_reconnect_after_receiver_restart():
+    """Kill the receiving transport, start a new one on the SAME port:
+    the sender's background reconnect must deliver fresh frames without
+    any sender-side intervention."""
+    p0, p1 = free_port(), free_port()
+    peers = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    s0, s1 = Sink(), Sink()
+    t0 = FrameTransport(0, peers[0], peers, s0.on_frame,
+                        s0.report_unreachable)
+    t1 = FrameTransport(1, peers[1], peers, s1.on_frame,
+                        s1.report_unreachable)
+    try:
+        assert wait_peers(t0)
+        t0.send(1, {"phase": 1})
+        assert s1.wait_n(1)
+        t1.stop()
+
+        s1b = Sink()
+        t1b = FrameTransport(1, peers[1], peers, s1b.on_frame,
+                             s1b.report_unreachable)
+        try:
+            deadline = time.time() + 20
+            got = False
+            i = 0
+            while time.time() < deadline and not got:
+                t0.send(1, {"phase": 2, "i": i})
+                i += 1
+                got = s1b.wait_n(1, timeout=0.2)
+            assert got, "reconnect never delivered"
+            assert s1b.frames[0][1]["phase"] == 2
+        finally:
+            t1b.stop()
+    finally:
+        t0.stop()
+
+
+def test_handler_exception_does_not_kill_stream():
+    p0, p1 = free_port(), free_port()
+    peers = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    s0 = Sink()
+    seen = []
+    cv = threading.Condition()
+
+    def bad_handler(frm, header, blob):
+        with cv:
+            seen.append(header)
+            cv.notify_all()
+        if header.get("boom"):
+            raise RuntimeError("handler bug")
+
+    t0 = FrameTransport(0, peers[0], peers, s0.on_frame,
+                        s0.report_unreachable)
+    t1 = FrameTransport(1, peers[1], peers, bad_handler)
+    try:
+        t0.send(1, {"boom": True})
+        t0.send(1, {"boom": False, "after": 1})
+        deadline = time.time() + 10
+        with cv:
+            while len(seen) < 2 and time.time() < deadline:
+                cv.wait(0.2)
+        assert len(seen) == 2, seen
+        assert seen[1]["after"] == 1
+    finally:
+        t0.stop()
+        t1.stop()
+
+
+def test_broadcast_reaches_every_peer():
+    ports = [free_port() for _ in range(3)]
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(3)}
+    sinks = [Sink() for _ in range(3)]
+    trs = [FrameTransport(i, peers[i], peers, sinks[i].on_frame,
+                          sinks[i].report_unreachable) for i in range(3)]
+    try:
+        assert wait_peers(trs[0])
+        trs[0].broadcast({"t": "all"}, b"payload")
+        for i in (1, 2):
+            assert sinks[i].wait_n(1)
+            assert sinks[i].frames[0] == (0, {"t": "all"}, b"payload")
+        assert not sinks[0].frames   # no self-delivery
+    finally:
+        for t in trs:
+            t.stop()

@@ -30,47 +30,74 @@ def _free_port():
 
 
 class Cluster:
-    def __init__(self, data, n=2, groups=4):
+    def __init__(self, data, n=2, groups=4, extra_env=None):
         self.data, self.n, self.groups = str(data), n, groups
+        self.extra_env = extra_env or {}
         self.http_ports = [_free_port() for _ in range(n)]
         self.frame_ports = [_free_port() for _ in range(n)]
         self.procs = []
+        self.gen = 0
 
     def start(self):
         coord = f"127.0.0.1:{_free_port()}"
         self.procs = []
+        self.gen += 1
         for r in range(self.n):
             env = dict(os.environ, MHE_RANK=str(r), MHE_NHOSTS=str(self.n),
                        MHE_COORD=coord, MHE_DATA=self.data,
                        MHE_GROUPS=str(self.groups),
                        MHE_HTTP_PORTS=",".join(map(str, self.http_ports)),
-                       MHE_FRAME_PORTS=",".join(map(str, self.frame_ports)))
+                       MHE_FRAME_PORTS=",".join(map(str, self.frame_ports)),
+                       **self.extra_env)
             env.pop("XLA_FLAGS", None)
+            # Rank output goes to per-generation log files (NOT devnull):
+            # a failing scenario dumps them, so CI failures are debuggable.
+            logf = open(os.path.join(self.data,
+                                     f"rank{r}.gen{self.gen}.log"), "ab")
             self.procs.append(subprocess.Popen(
                 [sys.executable, SCRIPT], env=env,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+                stdout=logf, stderr=subprocess.STDOUT))
+            logf.close()
         return self
 
     def base(self, h):
         return f"http://127.0.0.1:{self.http_ports[h]}"
 
+    def status(self, h, timeout=3):
+        return json.loads(urllib.request.urlopen(
+            self.base(h) + "/engine/status", timeout=timeout).read())
+
+    def dump_logs(self):
+        if getattr(self, "_dumped", False):
+            return   # idempotent: wait_up and test wrappers both call it
+        self._dumped = True
+        for name in sorted(os.listdir(self.data)):
+            if name.startswith("rank") and name.endswith(".log"):
+                with open(os.path.join(self.data, name),
+                          errors="replace") as f:
+                    tail = f.read()[-4000:]
+                print(f"\n===== {name} =====\n{tail}", file=sys.stderr)
+
     def wait_up(self, timeout=240):
         deadline = time.time() + timeout
-        for h in range(self.n):
-            while True:
-                if any(p.poll() is not None for p in self.procs):
-                    raise AssertionError(
-                        f"rank died: {[p.poll() for p in self.procs]}")
-                try:
-                    st = json.loads(urllib.request.urlopen(
-                        self.base(h) + "/engine/status", timeout=3).read())
-                    if st["groups_with_leader"] == self.groups:
-                        break
-                except Exception:
-                    pass
-                if time.time() > deadline:
-                    raise AssertionError(f"host {h} never converged")
-                time.sleep(0.5)
+        try:
+            for h in range(self.n):
+                while True:
+                    if any(p.poll() is not None for p in self.procs):
+                        raise AssertionError(
+                            f"rank died: {[p.poll() for p in self.procs]}")
+                    try:
+                        st = self.status(h)
+                        if st["groups_with_leader"] == self.groups:
+                            break
+                    except Exception:
+                        pass
+                    if time.time() > deadline:
+                        raise AssertionError(f"host {h} never converged")
+                    time.sleep(0.5)
+        except AssertionError:
+            self.dump_logs()
+            raise
 
     def kill_all(self):
         for p in self.procs:
@@ -184,5 +211,107 @@ def test_two_hosts_serve_forward_and_survive_sigkill(tmp_path):
 
         rcs = cl.terminate()
         assert rcs == [0, 0], rcs
+    finally:
+        cl.kill_all()
+
+
+def test_three_hosts_write_everywhere_and_converge(tmp_path):
+    """N=3: every host takes writes for every group (two of three
+    involve PROPOSE forwarding per group), all three converge on every
+    value, and a restart preserves everything (per-host WAL replay at
+    N>2)."""
+    cl = Cluster(tmp_path, n=3, groups=6).start()
+    try:
+        try:
+            cl.wait_up()
+            acked = {}
+            for i in range(36):
+                g, h = i % 6, i % 3
+                r = _put(cl.base(h), g, f"t{i}", f"w{i}")
+                if r["action"] == "set":
+                    acked[i] = h
+            assert len(acked) >= 30, f"only {len(acked)}/36 acked"
+
+            # Every host eventually serves every acked value (payload
+            # fan-out + apply on all three).
+            deadline = time.time() + 60
+            remaining = {(i, h) for i in acked for h in range(3)}
+            while remaining and time.time() < deadline:
+                for i, h in list(remaining):
+                    try:
+                        if (_get(cl.base(h), i % 6, f"t{i}")
+                                ["node"]["value"] == f"w{i}"):
+                            remaining.discard((i, h))
+                    except Exception:
+                        pass
+                if remaining:
+                    time.sleep(0.5)
+            assert not remaining, \
+                f"{len(remaining)} (write, host) pairs never converged"
+
+            cl.kill_all()
+            cl.start()
+            cl.wait_up()
+            for i, h in acked.items():
+                r = _get(cl.base(h), i % 6, f"t{i}")
+                assert r["node"]["value"] == f"w{i}", (i, r)
+            rcs = cl.terminate()
+            assert rcs == [0, 0, 0], rcs
+        except Exception:
+            cl.dump_logs()
+            raise
+    finally:
+        cl.kill_all()
+
+
+def test_payload_catchup_pull_path(tmp_path):
+    """Force the PULL catch-up path: 60% of outgoing PAYLOAD fan-out
+    frames are dropped (seeded), so non-admitting hosts stall their
+    apply cursors on missing payloads and must repair via pull. Writes
+    must still ack, every host must still converge on every value, and
+    the pull counters must show the path actually ran."""
+    cl = Cluster(tmp_path, n=2, groups=4,
+                 extra_env={"MHE_DROP_PAY_PCT": "60",
+                            "MHE_FAULT_SEED": "7",
+                            "MHE_REQ_TIMEOUT": "30"}).start()
+    try:
+        try:
+            cl.wait_up()
+            acked = {}
+            for i in range(32):
+                g, h = i % 4, i % 2
+                try:
+                    r = _put(cl.base(h), g, f"p{i}", f"x{i}", timeout=35)
+                    if r["action"] == "set":
+                        acked[i] = h
+                except Exception:
+                    pass
+            assert len(acked) >= 24, f"only {len(acked)}/32 acked " \
+                                     f"under payload drops"
+
+            # Convergence on the NON-acking host proves the pulls
+            # delivered the dropped payloads.
+            deadline = time.time() + 90
+            remaining = {(i, 1 - h) for i, h in acked.items()}
+            while remaining and time.time() < deadline:
+                for i, h in list(remaining):
+                    try:
+                        if (_get(cl.base(h), i % 4, f"p{i}")
+                                ["node"]["value"] == f"x{i}"):
+                            remaining.discard((i, h))
+                    except Exception:
+                        pass
+                if remaining:
+                    time.sleep(0.5)
+            assert not remaining, \
+                f"{len(remaining)} dropped payloads never repaired"
+
+            stats = [cl.status(h) for h in range(2)]
+            assert sum(s["pay_frames_dropped"] for s in stats) > 0, stats
+            assert sum(s["pulls_sent"] for s in stats) > 0, stats
+            assert sum(s["payloads_pulled"] for s in stats) > 0, stats
+        except Exception:
+            cl.dump_logs()
+            raise
     finally:
         cl.kill_all()
