@@ -325,18 +325,23 @@ class HostEngine:
     def _on_frame(self, frm: int, header: dict, blob: bytes) -> None:
         t = header.get("t")
         if t == "pull":
-            # Answer immediately from the payload store (read-only).
-            haves = [(g, i, tt) for g, i, tt in
-                     (tuple(w) for w in header.get("wants", []))
-                     if (g, i, tt) in self.payloads]
+            # Answer immediately from the payload store. Runs on the
+            # transport rx thread while the engine thread may GC the
+            # dict: snapshot each value with ONE .get per key (GIL-atomic)
+            # so a concurrent delete skips that key instead of raising
+            # out of the whole response.
+            haves = []
+            for w in header.get("wants", []):
+                key = tuple(w)
+                p = self.payloads.get(key)
+                if p is not None:
+                    haves.append((*key, p))
             if haves:
                 # Tagged as a pull RESPONSE so the receiver's repair
                 # counter stays exact (a late ordinary fan-out clearing a
                 # _missing marker is not a pull repair).
                 self.frames.send(frm, {"t": "pay", "pull": 1},
-                                 _pack_payloads(
-                                     [(g, i, tt, self.payloads[(g, i, tt)])
-                                      for g, i, tt in haves]))
+                                 _pack_payloads(haves))
             return
         self._rx.append((frm, header, blob))
 
