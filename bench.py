@@ -436,6 +436,25 @@ def child_main() -> int:
             extra["churned_groups"] = int((~stable).sum())
             extra["groups_with_leader_at_end"] = int(
                 (np.asarray(st.state) == LEADER).any(axis=1).sum())
+            # LIVENESS FLOOR: heal every partition and give churned
+            # groups 8 election ticks' worth of rounds — the randomized
+            # timeout draws up to 2x election_tick per attempt and a
+            # split vote costs another attempt, so 8x covers >=2 full
+            # attempts for every group. A shortfall past that is an
+            # election-starvation regression, not timing noise; flag it
+            # loudly in the artifact.
+            drop = None
+            heal_rounds = 8 * cfg.election_tick
+            for _ in range(heal_rounds):
+                st, inbox = one_round(0, st, inbox, slots, drop)
+            healed = int((np.asarray(st.state) == LEADER)
+                         .any(axis=1).sum())
+            extra["groups_with_leader_after_heal"] = healed
+            extra["liveness_floor_ok"] = bool(healed == G)
+            if healed != G:
+                log(f"LIVENESS FLOOR VIOLATION: {G - healed} groups "
+                    f"still leaderless {heal_rounds} rounds "
+                    f"after churn healed")
 
         log(f"[{scenario}] G={G} P={P}: {commits_t} commits in "
             f"{t_elapsed:.2f}s / {n_t} pipelined rounds "

@@ -225,10 +225,8 @@ class ProxyServer:
             self._peer_urls, tls_context=self._peer_ctx)
         if peer_urls:
             self._peer_urls = peer_urls
-            tmp = self._clusterfile + ".bak"
-            with open(tmp, "w") as f:
-                json.dump({"PeerURLs": peer_urls}, f)
-            os.replace(tmp, self._clusterfile)
+            from etcd_tpu.proxy import write_cluster_file
+            write_cluster_file(self.cfg.data_dir, peer_urls)
         return client_urls
 
     @property

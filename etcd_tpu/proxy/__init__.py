@@ -1,6 +1,6 @@
-from etcd_tpu.proxy.director import Director, Endpoint
+from etcd_tpu.proxy.director import Director, Endpoint, write_cluster_file
 from etcd_tpu.proxy.reverse import (ReverseProxy, fetch_cluster_urls,
                                     readonly)
 
 __all__ = ["Director", "Endpoint", "ReverseProxy", "fetch_cluster_urls",
-           "readonly"]
+           "readonly", "write_cluster_file"]

@@ -78,3 +78,20 @@ class Director:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+def write_cluster_file(data_dir: str, peer_urls) -> str:
+    """Atomically persist the proxy's endpoint view at
+    <data_dir>/proxy/cluster — THE schema ProxyServer boots from and
+    refreshes (single owner of the file format; the standby migration
+    writes through here too). Returns the file path."""
+    import json
+    import os
+    proxy_dir = os.path.join(data_dir, "proxy")
+    os.makedirs(proxy_dir, exist_ok=True)
+    path = os.path.join(proxy_dir, "cluster")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"PeerURLs": list(peer_urls)}, f)
+    os.replace(tmp, path)
+    return path

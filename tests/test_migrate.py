@@ -157,3 +157,69 @@ def test_snapshot4_conversion():
     st = Store()
     st.recovery(snap2.data)
     assert st.get("/1/app/k").node.value == "v"
+
+
+def test_standby_info_conversion_boots_a_proxy(tmp_path):
+    """v0.4 standby -> v2 proxy (reference migrate/standby.go): decode the
+    standby_info registry, derive initial-cluster/client URLs, write the
+    proxy cluster file — then BOOT a real proxy from the converted data
+    dir (no --initial-cluster needed) and serve KV through it."""
+    from etcd_tpu.etcdmain.config import MainConfig
+    from etcd_tpu.etcdmain.etcd import ProxyServer
+    from etcd_tpu.migrate import decode_standby_info, standby_to_proxy
+
+    # A live member the registry points at.
+    pport, cport = free_ports(2)
+    m = Etcd(EtcdConfig(
+        name="m0", data_dir=str(tmp_path / "m0"),
+        initial_cluster={"m0": [f"http://127.0.0.1:{pport}"]},
+        listen_client_urls=[f"http://127.0.0.1:{cport}"],
+        advertise_client_urls=[f"http://127.0.0.1:{cport}"],
+        tick_ms=10, request_timeout=5.0))
+    m.start()
+    try:
+        assert m.wait_leader(30)
+
+        # The v0.4 standby's registry file.
+        src = tmp_path / "standby04"
+        src.mkdir()
+        (src / "standby_info").write_text(json.dumps({
+            "Running": True,
+            "SyncInterval": 5.0,
+            "Cluster": [
+                {"name": "m0", "state": "leader",
+                 "clientURL": f"http://127.0.0.1:{cport}",
+                 "peerURL": f"http://127.0.0.1:{pport}"},
+            ],
+        }))
+
+        info = decode_standby_info(str(src / "standby_info"))
+        assert info.running and info.sync_interval == 5.0
+        assert info.initial_cluster() == f"m0=http://127.0.0.1:{pport}"
+        assert info.client_urls() == [f"http://127.0.0.1:{cport}"]
+
+        dst = tmp_path / "proxy_v2"
+        standby_to_proxy(str(src), str(dst))
+        with open(dst / "proxy" / "cluster") as f:
+            assert json.load(f)["PeerURLs"] == \
+                [f"http://127.0.0.1:{pport}"]
+
+        # Boot the proxy from the converted dir alone.
+        cfg = MainConfig()
+        cfg.data_dir = str(dst)
+        cfg.proxy = "on"
+        cfg.listen_client_urls = ("http://127.0.0.1:0",)
+        p = ProxyServer(cfg)
+        p.start()
+        try:
+            p.director.refresh()
+            base = p.client_urls[0]
+            st, _, body = req("PUT", base + "/v2/keys/standby",
+                              b"value=promoted",
+                              {"Content-Type":
+                               "application/x-www-form-urlencoded"})
+            assert st == 201 and body["node"]["value"] == "promoted"
+        finally:
+            p.stop()
+    finally:
+        m.stop()
