@@ -22,11 +22,13 @@ from etcd_tpu.etcdhttp.web import HttpServer, Router
 from etcd_tpu.utils.tlsutil import TLSInfo
 from etcd_tpu.etcdmain.config import (ConfigError, MainConfig,
                                       PROXY_READONLY, parse_args)
-from etcd_tpu.proxy import Director, ReverseProxy, fetch_cluster_urls, readonly
+from etcd_tpu.proxy import (Director, ReverseProxy, fetch_cluster_urls,
+                            readonly, write_cluster_file)
+from etcd_tpu.proxy.director import PROXY_DIR_NAME
 
 log = logging.getLogger("etcdmain")
 
-DIR_MEMBER, DIR_PROXY, DIR_ENGINE, DIR_EMPTY = ("member", "proxy",
+DIR_MEMBER, DIR_PROXY, DIR_ENGINE, DIR_EMPTY = ("member", PROXY_DIR_NAME,
                                                 "engine", "empty")
 
 
@@ -225,7 +227,6 @@ class ProxyServer:
             self._peer_urls, tls_context=self._peer_ctx)
         if peer_urls:
             self._peer_urls = peer_urls
-            from etcd_tpu.proxy import write_cluster_file
             write_cluster_file(self.cfg.data_dir, peer_urls)
         return client_urls
 

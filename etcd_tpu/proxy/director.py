@@ -80,6 +80,11 @@ class Director:
         self._stop.set()
 
 
+# The proxy's subdirectory inside a data dir; etcdmain's DIR_PROXY and
+# every cluster-file path derive from this single definition.
+PROXY_DIR_NAME = "proxy"
+
+
 def write_cluster_file(data_dir: str, peer_urls) -> str:
     """Atomically persist the proxy's endpoint view at
     <data_dir>/proxy/cluster — THE schema ProxyServer boots from and
@@ -87,7 +92,7 @@ def write_cluster_file(data_dir: str, peer_urls) -> str:
     writes through here too). Returns the file path."""
     import json
     import os
-    proxy_dir = os.path.join(data_dir, "proxy")
+    proxy_dir = os.path.join(data_dir, PROXY_DIR_NAME)
     os.makedirs(proxy_dir, exist_ok=True)
     path = os.path.join(proxy_dir, "cluster")
     tmp = path + ".tmp"
