@@ -937,6 +937,28 @@ def step_routed_slots(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     return st, route_local(outbox)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 6), donate_argnums=(1, 2))
+def step_routed_slots_auto(cfg: KernelConfig, st: GroupState,
+                           inbox: jax.Array, cnt_gp: jax.Array,
+                           tick: jax.Array, drop_mask=None,
+                           hops: int = 1) -> Tuple[GroupState, jax.Array]:
+    """step_routed_slots with the quiescent fast path (and the same
+    multi-hop/drop-mask machinery as step_routed_auto — this IS that
+    function with per-slot admission selected via prop_slot=None).
+
+    DURABILITY CONSTRAINT (multi-host callers): hops MUST stay 1 when
+    peers are sharded across independently-failing hosts. With hops>1
+    the leader consumes follower acks produced ON DEVICE, before those
+    followers' hosts have journaled the appended entries — quorum commit
+    would then cover unpersisted replicas, and a follower-host crash
+    after the collective but before its WAL append could elect a new
+    quorum WITHOUT an acked entry (the exact loss the persist-before-
+    send contract exists to prevent). Multi-hop is safe only where all
+    peers share one failure domain (the single-host MultiEngine)."""
+    return step_routed_auto.__wrapped__(cfg, st, inbox, cnt_gp, None,
+                                        tick, drop_mask, hops)
+
+
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
 def step_routed(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
                 prop_count: jax.Array, prop_slot: jax.Array,

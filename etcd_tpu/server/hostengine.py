@@ -88,6 +88,13 @@ class HostEngineConfig:
     round_interval: float = 0.0
     stagger: bool = True
     pull_interval: float = 0.25    # payload catch-up request pacing
+    # Message hops per collective invocation. MUST remain 1 in
+    # multi-host deployments: with hops>1 the leader would quorum-commit
+    # on follower acks produced before those hosts journaled the entries
+    # (kernel.step_routed_slots_auto's durability constraint) — an
+    # acked write could then be lost to a follower-host crash. The
+    # latency win here comes from the quiescent fast path alone.
+    hops: int = 1
     # Fault injection (tests/chaos, reference rafthttp.Pausable analogue):
     # drop this percentage of outgoing per-peer PAYLOAD fan-out frames,
     # forcing the receiving hosts onto the PULL catch-up path. Seeded for
@@ -132,8 +139,8 @@ class HostEngine:
         self._mb_sh = mailbox_sharding(self.mesh)
         self._cnt_sh = NamedSharding(self.mesh, P("groups", "peers"))
         self._step_fn = jax.jit(
-            functools.partial(kernel.step_routed_slots.__wrapped__,
-                              self.kcfg),
+            functools.partial(kernel.step_routed_slots_auto.__wrapped__,
+                              self.kcfg, hops=cfg.hops),
             donate_argnums=(0, 1),
             out_shardings=(self._st_sh, self._mb_sh))
 
@@ -859,7 +866,19 @@ class HostEngine:
         self.wal.save_checkpoint(self.round_no - 1, state)
 
     def _gc_payloads(self) -> None:
-        dead = [k for k in self.payloads if k[1] <= self.applied[k[0]]]
+        """Drop applied payloads — EXCEPT the trailing ring window: a
+        peer host that crashed before receiving a payload repairs it via
+        PULL after restart, and OUR applied cursor says nothing about how
+        far behind that peer's cursor is. Any index still resolvable from
+        the device ring (i > last - W) must stay answerable; a peer
+        lagging beyond the ring is the documented cross-host snapshot
+        case, not a pull. (Dropping by local `applied` alone left a
+        restarted peer's group stuck forever: it pulled an index nobody
+        retained — found by the supervisor recovery test.)"""
+        W = self.cfg.window
+        dead = [k for k in self.payloads
+                if k[1] <= self.applied[k[0]]
+                and k[1] <= self.l_last[k[0]] - W]
         for k in dead:
             del self.payloads[k]
 

@@ -255,6 +255,16 @@ def test_three_hosts_write_everywhere_and_converge(tmp_path):
             for i, h in acked.items():
                 r = _get(cl.base(h), i % 6, f"t{i}")
                 assert r["node"]["value"] == f"w{i}", (i, r)
+            # Post-restart WRITES to every group via a different host
+            # than pre-restart: regression guard for the restore-time
+            # payload GC starving peer catch-up pulls (a host killed
+            # before receiving a payload must be able to repair it after
+            # restart, or its apply cursor — and every ack it owes —
+            # stalls forever).
+            for g in range(6):
+                r = _put(cl.base((g + 1) % 3), g, "after", f"a{g}",
+                         timeout=30)
+                assert r["action"] == "set", (g, r)
             rcs = cl.terminate()
             assert rcs == [0, 0, 0], rcs
         except Exception:

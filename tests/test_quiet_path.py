@@ -159,3 +159,39 @@ def test_multihop_commits_proposal_in_one_round():
                                         jnp.asarray(True), None, 3)
     commit1 = np.asarray(st.commit).max(axis=1)
     assert (commit1 >= commit0 + 2).all(), (commit0, commit1)
+
+
+def test_slots_auto_matches_full_slots_kernel():
+    """The multi-host step's auto+multi-hop variant must be trajectory-
+    identical to the always-full step_routed_slots chained hop by hop
+    (per-slot proposals + tick on hop 0 only)."""
+    G, P, H = 6, 3, 3
+    cfg = KernelConfig(groups=G, peers=P, window=8, max_ents=2,
+                       election_tick=10, heartbeat_tick=3)
+    rng = np.random.default_rng(13)
+
+    st_a = init_state(cfg, stagger=True)
+    st_f = init_state(cfg, stagger=True)
+    in_a = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+    in_f = jnp.zeros((G, P, P, cfg.fields), jnp.int32)
+    zero_gp = jnp.zeros((G, P), jnp.int32)
+    false = jnp.asarray(False)
+
+    for r in range(60):
+        state = np.asarray(st_f.state)
+        cnt = np.zeros((G, P), np.int32)
+        lead = (state == LEADER)
+        cnt[lead] = rng.integers(0, cfg.max_ents + 1,
+                                 size=int(lead.sum()))
+        cnt_j = jnp.asarray(cnt)
+
+        st_a, in_a = kernel.step_routed_slots_auto(
+            cfg, st_a, in_a, cnt_j, jnp.asarray(True), None, H)
+        for h in range(H):
+            st_f, in_f = kernel.step_routed_slots(
+                cfg, st_f, in_f, cnt_j if h == 0 else zero_gp,
+                jnp.asarray(True) if h == 0 else false)
+        _assert_same(st_a, st_f, in_a, in_f, r)
+
+    commit = np.asarray(st_a.commit)
+    assert (commit.max(axis=1) > 5).all(), commit
