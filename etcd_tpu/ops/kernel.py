@@ -414,15 +414,15 @@ def _stage(resp: jax.Array, mask: jax.Array, mtype: int, term: jax.Array,
 def _terms_at_many(st: GroupState, cfg: KernelConfig,
                    idx: jax.Array) -> jax.Array:
     """term_at for an extra trailing axis of indices: idx (G, P, E) ->
-    terms (G, P, E); 0 outside the window / beyond last.
-
-    ETCD_TPU_PALLAS=1 (checked at trace time) routes this resolve
-    through the explicit Pallas kernel (ops/pallas_kernels.py) — same
-    windowed semantics, provided for per-backend re-measurement; the
-    XLA-fused one-hot path below is the measured default."""
-    from etcd_tpu.ops import pallas_kernels
-    if pallas_kernels.use_pallas():
-        return pallas_kernels.ring_resolve(st.log_term, idx, st.last_index)
+    terms (G, P, E); 0 outside the window / beyond last. The one-hot
+    select-sum below IS the measured-fastest TPU formulation (it replaced
+    the take_along_axis gathers that originally dominated the round); an
+    explicit Pallas variant of this resolve was prototyped and removed —
+    it never demonstrated a win over the XLA fusion on real hardware, and
+    an unmeasured alternate on the hottest op is a liability, not an
+    option (r3 verdict). scripts/pallas_bench.py retains the standalone
+    harness to re-measure a Pallas candidate against this path before any
+    future reintroduction."""
     slot = jnp.mod(idx, cfg.window)
     t = ring_lookup(st.log_term, slot)
     last = st.last_index[..., None]

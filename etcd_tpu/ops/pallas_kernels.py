@@ -18,16 +18,16 @@ holding each block's ring rows (BR, W) and index rows (BR, TE) in VMEM
 and computing the masked one-hot contraction in one pass — no HBM
 intermediates regardless of how XLA would schedule the jnp version.
 
-Enable via ETCD_TPU_PALLAS=1 — ops.kernel._terms_at_many consults
-use_pallas() at trace time (set the env var before the first step()
-trace, or clear the jit caches). On CPU the kernel runs in interpret
-mode (tests); performance claims are only meaningful on real TPU.
-scripts/pallas_bench.py measures both paths standalone.
+This module is a MEASUREMENT CANDIDATE, not a production path: the
+r3 verdict's measure-or-delete call removed the runtime flag that could
+route the hot kernel through it unmeasured. scripts/pallas_bench.py
+benchmarks it against the production one-hot path per backend; only a
+demonstrated TPU win earns it a call site. On CPU it runs in interpret
+mode (tests pin its windowed-resolve semantics).
 """
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -98,9 +98,3 @@ def ring_resolve(ring: jax.Array, idx: jax.Array, last: jax.Array,
         interpret=interpret,
     )(ring2, idx2, last2)
     return out[:R].reshape((G, P) + trailing)
-
-
-def use_pallas() -> bool:
-    """Whether ops.kernel should route resolves through Pallas (opt-in;
-    default stays on the XLA-fused jnp path per measurement)."""
-    return os.environ.get("ETCD_TPU_PALLAS", "") == "1"

@@ -3,7 +3,7 @@
 on whatever backend is live (meaningful on real TPU; CPU runs interpret
 mode and only validates correctness).
 
-Decides whether ops.kernel should flip ETCD_TPU_PALLAS on by default —
+Measures whether a Pallas ring-resolve could beat the production one-hot path (which would justify giving it a call site) —
 SURVEY §7 scopes Pallas as "only if XLA fusion is insufficient", and the
 jnp one-hot path won the last TPU measurement (README). Usage:
 

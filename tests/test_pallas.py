@@ -60,26 +60,3 @@ def test_ring_resolve_matches_kernel_term_at():
     got = np.asarray(ring_resolve(st.log_term, idx[..., None],
                                   st.last_index, block_rows=3))[..., 0]
     assert (got == want).all()
-
-
-def test_pallas_path_full_equivalence(monkeypatch):
-    """With ETCD_TPU_PALLAS=1 the whole kernel (conflict scan + prev-term
-    resolve through the Pallas kernel) must still match the scalar oracle
-    on a randomized schedule."""
-    import os
-    import sys
-
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), "tests"))
-    from test_equivalence import run_equivalence
-    from etcd_tpu.ops import kernel
-
-    monkeypatch.setenv("ETCD_TPU_PALLAS", "1")
-    kernel.step.clear_cache()
-    kernel.step_routed.clear_cache()
-    try:
-        run_equivalence(seed=3, rounds=80)
-    finally:
-        monkeypatch.delenv("ETCD_TPU_PALLAS")
-        kernel.step.clear_cache()
-        kernel.step_routed.clear_cache()
