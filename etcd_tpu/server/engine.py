@@ -60,7 +60,7 @@ from etcd_tpu.server.enginewal import (CONF_ADD, CONF_REMOVE, EngineWAL,
 from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, METHOD_QGET, METHOD_SYNC,
                                      Request)
-from etcd_tpu.store import Store
+from etcd_tpu.store import new_store
 from etcd_tpu.utils import idutil
 from etcd_tpu.utils.wait import Wait
 
@@ -246,7 +246,7 @@ class MultiEngine:
         # Per group: the entries staged this round, each a list of
         # (request id, tagged payload) items coalesced into one log entry.
         self._staged: Dict[int, List[List[Tuple[int, bytes]]]] = {}
-        self._stores: Dict[int, Store] = {}
+        self._stores: Dict[int, Any] = {}
         self._lock = threading.Lock()       # guards _pending/_dirty enqueue
         self._stop_ev = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -428,7 +428,7 @@ class MultiEngine:
             self.applied = pool_pad(b64_np(ckpt["applied"])
                                     .astype(np.int64))
             for g_s, blob in ckpt["stores"].items():
-                st = Store(namespaces=("/0", "/1"))
+                st = new_store(namespaces=("/0", "/1"))
                 st.recovery(blob.encode())
                 self._stores[int(g_s)] = st
             for g, i, t, b64p in ckpt["payloads"]:
@@ -651,7 +651,7 @@ class MultiEngine:
             e, self._apply_exc = self._apply_exc, None
             raise e
 
-    def store(self, g: int) -> Store:
+    def store(self, g: int):
         s = self._stores.get(g)
         if s is None:
             # Lock: HTTP handler threads race the engine apply thread on
@@ -663,7 +663,7 @@ class MultiEngine:
             with self._lock:
                 s = self._stores.get(g)
                 if s is None:
-                    s = self._stores[g] = Store(namespaces=("/0", "/1"))
+                    s = self._stores[g] = new_store(namespaces=("/0", "/1"))
         return s
 
     def leader_slot(self, g: int) -> int:
@@ -1359,6 +1359,12 @@ class MultiEngine:
             if r.prev_index or r.prev_value:
                 return st.compare_and_swap(r.path, r.prev_value,
                                            r.prev_index, r.val, exp)
+            if not r.dir:
+                # Unconditional file PUT — the apply loop's dominant op.
+                # The native store skips Event materialization entirely
+                # unless a waiter holds this id or a watcher is live.
+                return st.set_applied(r.path, r.val, exp,
+                                      self.wait.is_registered(r.id))
             return st.set(r.path, is_dir=r.dir, value=r.val, expire_time=exp)
         if r.method == METHOD_DELETE:
             if r.prev_index or r.prev_value:

@@ -58,7 +58,7 @@ from etcd_tpu.server.enginewal import EngineWAL, RoundRecord, b64_np, np_b64
 from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, METHOD_QGET, METHOD_SYNC,
                                      Request)
-from etcd_tpu.store import Store
+from etcd_tpu.store import new_store
 from etcd_tpu.utils import idutil
 from etcd_tpu.utils.wait import Wait
 
@@ -151,7 +151,7 @@ class HostEngine:
         self._pending: List[deque] = [deque() for _ in range(G)]
         self._dirty: set = set()
         self._staged: Dict[int, List[List[Tuple[int, bytes]]]] = {}
-        self._stores: Dict[int, Store] = {}
+        self._stores: Dict[int, Any] = {}
         self._lock = threading.Lock()
         self._stop_ev = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -256,7 +256,7 @@ class HostEngine:
             self.l_ring = b64_np(ckpt["ring"]).astype(np.int32)
             self.applied = b64_np(ckpt["applied"]).astype(np.int64)
             for g_s, blob in ckpt["stores"].items():
-                st = Store(namespaces=("/0", "/1"))
+                st = new_store(namespaces=("/0", "/1"))
                 st.recovery(blob.encode())
                 self._stores[int(g_s)] = st
             import base64 as _b64
@@ -410,13 +410,13 @@ class HostEngine:
         self.frames.stop()
         self.wal.close()
 
-    def store(self, g: int) -> Store:
+    def store(self, g: int):
         s = self._stores.get(g)
         if s is None:
             with self._lock:
                 s = self._stores.get(g)
                 if s is None:
-                    s = self._stores[g] = Store(namespaces=("/0", "/1"))
+                    s = self._stores[g] = new_store(namespaces=("/0", "/1"))
         return s
 
     def leader_slot(self, g: int) -> int:
@@ -817,6 +817,10 @@ class HostEngine:
             if r.prev_index or r.prev_value:
                 return st.compare_and_swap(r.path, r.prev_value,
                                            r.prev_index, r.val, exp)
+            if not r.dir:
+                # see engine._apply_request: lazy-event fast path
+                return st.set_applied(r.path, r.val, exp,
+                                      self.wait.is_registered(r.id))
             return st.set(r.path, is_dir=r.dir, value=r.val, expire_time=exp)
         if r.method == METHOD_DELETE:
             if r.prev_index or r.prev_value:

@@ -43,7 +43,7 @@ from etcd_tpu.server.stats import LeaderStats, ServerStats
 from etcd_tpu.server.storage import ServerStorage, read_wal
 from etcd_tpu.server.transport import Transporter
 from etcd_tpu.snap import Snapshotter
-from etcd_tpu.store import Store
+from etcd_tpu.store import new_store
 from etcd_tpu.utils import idutil, metrics
 from etcd_tpu.utils.fileutil import touch_dir_all, purge_files
 from etcd_tpu.utils.wait import Wait
@@ -130,7 +130,7 @@ class EtcdServer:
             transport.bind(self)
         # Namespace dirs exist from boot and are write-protected (reference
         # server.go:173 store.New(StoreClusterPrefix, StoreKeysPrefix)).
-        self.store = Store(clock=clock,
+        self.store = new_store(clock=clock,
                            namespaces=(cl.STORE_CLUSTER_PREFIX,
                                        STORE_KEYS_PREFIX))
         touch_dir_all(cfg.snapdir)

@@ -215,6 +215,14 @@ class Store:
                 self.stats.inc("setsFail")
                 raise
 
+    def set_applied(self, node_path: str, value: str,
+                    expire_time: Optional[float],
+                    need_event: bool) -> Optional[Event]:
+        """PUT-set on the engine apply loop. The NativeStore skips Event
+        materialization when nobody consumes it (need_event False and no
+        watchers); the Python reference store is always eager."""
+        return self.set(node_path, value=value, expire_time=expire_time)
+
     def update(self, node_path: str, value: Optional[str] = None,
                expire_time: Optional[float] = None,
                refresh: bool = False) -> Event:

@@ -108,11 +108,16 @@ class WatcherHub:
             self.count -= 1
         w.removed = True
 
+    def _record(self, e: Event) -> Event:
+        """History hook: the native store's hub overrides this to a no-op
+        (its C core appends the ring record inside the mutation op)."""
+        return self.event_history.add(e)
+
     def notify(self, e: Event) -> None:
         """Record the event and fire watchers along the ancestor chain
         (reference watcher_hub.go:111-133)."""
         with self._lock:
-            e = self.event_history.add(e)
+            e = self._record(e)
             if self.count == 0:
                 # History is recorded either way (wait-index queries need
                 # it); with no watchers registered, skip the ancestor

@@ -15,7 +15,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from etcd_tpu.utils.platform import enable_compile_cache, force_cpu  # noqa: E402
 
-force_cpu(1)
+if os.environ.get("PROFILE_TPU") != "1":
+    force_cpu(1)
 enable_compile_cache()
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,211 @@
+"""Differential conformance: the C-core NativeStore vs the Python Store.
+
+Random op schedules (seeded) run against both implementations; every
+result event, every raised error (code+cause+index), the stats counters,
+the full save() snapshot bytes and the expiry stream must agree. This is
+the native core's fuzz oracle, on top of the scripted matrix in
+test_store.py which runs parametrized over both classes.
+"""
+import json
+import random
+
+import pytest
+
+from etcd_tpu import errors
+from etcd_tpu.store.store import Store
+
+native_store = pytest.importorskip("etcd_tpu.store.native_store")
+NativeStore = native_store.NativeStore
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def ev_sig(e):
+    def nd(x):
+        if x is None:
+            return None
+        return (x.key, x.value, x.dir, x.created_index, x.modified_index,
+                x.expiration, x.ttl,
+                None if x.nodes is None else tuple(nd(c) for c in x.nodes))
+    return (e.action, nd(e.node), nd(e.prev_node), e.etcd_index)
+
+
+def run_op(st, op):
+    kind = op[0]
+    if kind == "set":
+        return st.set(op[1], is_dir=op[2], value=op[3], expire_time=op[4])
+    if kind == "create":
+        return st.create(op[1], is_dir=op[2], value=op[3], unique=op[4],
+                         expire_time=op[5])
+    if kind == "update":
+        return st.update(op[1], value=op[2], expire_time=op[3],
+                         refresh=op[4])
+    if kind == "cas":
+        return st.compare_and_swap(op[1], op[2], op[3], op[4])
+    if kind == "cad":
+        return st.compare_and_delete(op[1], op[2], op[3])
+    if kind == "delete":
+        return st.delete(op[1], is_dir=op[2], recursive=op[3])
+    if kind == "get":
+        return st.get(op[1], recursive=op[2], want_sorted=op[3])
+    if kind == "expire":
+        return st.delete_expired_keys(op[1])
+    raise AssertionError(kind)
+
+
+def gen_op(rng, clock):
+    segs = ["a", "b", "_hid", "x", "longer-seg"]
+    def path():
+        return "/" + "/".join(rng.choice(segs)
+                              for _ in range(rng.randint(1, 3)))
+    k = rng.random()
+    exp = clock.t + rng.choice([0.5, 2.0, 10.0]) if rng.random() < 0.3 \
+        else None
+    if k < 0.30:
+        return ("set", path(), rng.random() < 0.15,
+                rng.choice(["", "v", "w" * 40]), exp)
+    if k < 0.45:
+        return ("create", path(), rng.random() < 0.2, "cv",
+                rng.random() < 0.2, exp)
+    if k < 0.55:
+        return ("update", path(), rng.choice([None, "", "u2"]), exp,
+                rng.random() < 0.2)
+    if k < 0.65:
+        return ("cas", path(), rng.choice(["", "v", "nope"]),
+                rng.choice([0, 1, 3]), "casv")
+    if k < 0.72:
+        return ("cad", path(), rng.choice(["", "v", "nope"]),
+                rng.choice([0, 1, 3]))
+    if k < 0.85:
+        return ("delete", path(), rng.random() < 0.5, rng.random() < 0.5)
+    if k < 0.95:
+        return ("get", path(), rng.random() < 0.5, rng.random() < 0.5)
+    return ("expire", clock.t + rng.choice([0.0, 1.0, 5.0]))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_random_schedule(seed):
+    rng = random.Random(seed)
+    clock = Clock()
+    py = Store(clock=clock, namespaces=("/0",))
+    na = NativeStore(clock=clock, namespaces=("/0",))
+    for i in range(400):
+        if rng.random() < 0.05:
+            clock.t += rng.choice([0.25, 1.0, 3.0])
+        op = gen_op(rng, clock)
+        rp = rn = ep = en = None
+        try:
+            rp = run_op(py, op)
+        except errors.EtcdError as e:
+            ep = (e.code, e.cause, e.index)
+        try:
+            rn = run_op(na, op)
+        except errors.EtcdError as e:
+            en = (e.code, e.cause, e.index)
+        assert ep == en, f"op {i} {op}: error mismatch {ep} vs {en}"
+        if ep is None:
+            if op[0] == "expire":
+                assert [ev_sig(e) for e in rp] == [ev_sig(e) for e in rn], \
+                    f"op {i} {op}"
+            else:
+                assert ev_sig(rp) == ev_sig(rn), f"op {i} {op}"
+        assert py.current_index == na.current_index
+    # end state: identical snapshots and counters
+    assert py.save() == na.save()
+    sp, sn = py.json_stats(), na.json_stats()
+    assert sp == sn
+
+
+def test_differential_recovery_roundtrip():
+    rng = random.Random(99)
+    clock = Clock()
+    py = Store(clock=clock, namespaces=("/0",))
+    na = NativeStore(clock=clock, namespaces=("/0",))
+    for _ in range(150):
+        op = gen_op(rng, clock)
+        for st in (py, na):
+            try:
+                run_op(st, op)
+            except errors.EtcdError:
+                pass
+    blob = py.save()
+    na2 = NativeStore(clock=clock, namespaces=("/0",))
+    na2.recovery(blob)
+    assert na2.save() == blob  # byte-identical roundtrip through C load
+    py2 = Store(clock=clock, namespaces=("/0",))
+    py2.recovery(na.save())    # python recovers a native snapshot
+    assert py2.save() == na.save()
+    # clone is deep: mutating the clone leaves the original untouched
+    c = na.clone()
+    before = na.save()
+    c.set("/mut", value="x")
+    assert na.save() == before
+    assert json.loads(c.save())["currentIndex"] == na.current_index + 1
+
+
+def test_watch_vs_lazy_apply_race():
+    """A watcher registering concurrently with set_applied must never
+    lose an event: either registration completes first (the mutation's
+    post-op locked count check sees it and notifies) or the watcher's
+    history scan replays the already-recorded ring event. An unlocked
+    pre-mutation count check had a window that dropped events forever
+    (code-review finding, round 4)."""
+    import threading
+
+    st = NativeStore()
+    stop = threading.Event()
+    idx_hint = [0]
+
+    def writer():
+        while not stop.is_set():
+            e = st.set_applied("/race/k", "v", None, False)
+            if e is not None:
+                idx_hint[0] = e.index
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        misses = 0
+        for _ in range(300):
+            since = st.current_index + 1
+            w = st.watch("/race/k", since_index=since)
+            e = w.next_event(timeout=2.0)
+            if e is None:
+                misses += 1
+            else:
+                assert e.index >= since
+            w.remove()
+        assert misses == 0, f"{misses}/300 watchers lost their event"
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_watch_parity_through_native():
+    clock = Clock()
+    for cls in (Store, NativeStore):
+        st = cls(clock=clock)
+        w = st.watch("/w", recursive=True, stream=True)
+        st.set("/w/a", value="1")
+        st.delete("/w/a")
+        st.set("/w/_h", value="hidden")     # hidden: invisible to recursive
+        st.set("/w/b", value="2", expire_time=clock.t + 1)
+        st.delete_expired_keys(clock.t + 2)
+        acts = []
+        while True:
+            e = w.next_event(timeout=0.05)
+            if e is None:
+                break
+            acts.append((e.action, e.node.key))
+        assert acts == [("set", "/w/a"), ("delete", "/w/a"),
+                        ("set", "/w/b"), ("expire", "/w/b")], (cls, acts)
+        # history scan: a new watcher with since sees the old event
+        w2 = st.watch("/w/a", since_index=1)
+        e = w2.next_event(timeout=0.05)
+        assert e is not None and e.action == "set" and e.node.key == "/w/a"

@@ -24,9 +24,22 @@ def clock():
     return Clock()
 
 
-@pytest.fixture
-def s(clock):
-    return Store(clock=clock)
+def _store_impls():
+    impls = [Store]
+    try:
+        from etcd_tpu.store.native_store import NativeStore
+        impls.append(NativeStore)
+    except ImportError:
+        pass
+    return impls
+
+
+@pytest.fixture(params=_store_impls(), ids=lambda c: c.__name__)
+def s(request, clock):
+    """Every scenario runs against BOTH the Python reference store and the
+    C-core NativeStore (when built) — the matrix is the native core's
+    conformance suite."""
+    return request.param(clock=clock)
 
 
 class TestCreateGet:
