@@ -793,7 +793,11 @@ def test_v3_survives_snapshot_catchup(tmp_path):
     members[2] = mk(2)
     members[2].start()
     want = rng(0, "k", "l")
-    deadline = _t.time() + 90   # generous: shared CI boxes stall restarts
+    # Generous: under a full-suite run on the single-core CI box the
+    # restarted member competes with every other live thread for the one
+    # core — 90s was observed to fall short (r4) while the same restart
+    # converges in ~3s on an idle box.
+    deadline = _t.time() + 240
     while _t.time() < deadline:
         try:
             got = rng(2, "k", "l")
