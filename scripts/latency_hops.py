@@ -1,0 +1,69 @@
+"""Propose->commit latency of the multi-hop kernel vs group count.
+
+The north-star latency target (BASELINE.md: p99 commit <10ms at 100k
+groups on a v5e-8) concerns the DEVICE commit pipeline: with
+`step_routed_auto(hops=3)` a proposal admitted on hop 0 is replicated
+and quorum-committed within the SAME compiled invocation
+(ops/kernel.py:884-894), so per-proposal commit latency is bounded by
+one pipelined round (queueing adds at most one more). This script
+measures that round time at the per-chip group counts that matter:
+100k/8 = 12.5k groups/chip on the target v5e-8, plus single-chip
+sweeps. Usage:
+
+    python scripts/latency_hops.py [G ...]   # default: 12500 32768 100000
+
+Measured on TPU v5 lite (2026-07-31, docs/perf.md):
+  G=12,500: 2.11 ms/round  -> worst-case 2-round commit 4.2 ms  (<10ms)
+  G=32,768: 5.07 ms/round  -> 10.1 ms
+  G=100,000 (one chip): 18.1 ms/round, 22.1M commits/s
+"""
+import functools
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from etcd_tpu.ops import kernel  # noqa: E402
+from etcd_tpu.ops.state import KernelConfig, init_state  # noqa: E402
+
+
+def measure(G: int, hops: int = 3, peers: int = 5, rounds: int = 80):
+    cfg = KernelConfig(groups=G, peers=peers, window=16, max_ents=4,
+                       election_tick=10, heartbeat_tick=3)
+    st = init_state(cfg, stagger=True)
+    inbox = jnp.zeros((G, peers, peers, cfg.fields), jnp.int32)
+    zero = jnp.zeros(G, jnp.int32)
+    for _ in range(40):
+        st, inbox = kernel.step_routed_auto(cfg, st, inbox, zero, zero,
+                                            jnp.asarray(True))
+    jax.block_until_ready(st.commit)
+    state = np.asarray(st.state)
+    assert (state == 2).any(axis=1).all(), "elections did not converge"
+    slots = jnp.asarray(np.argmax(state == 2, axis=1).astype(np.int32))
+    full = jnp.full(G, cfg.max_ents, jnp.int32)
+    fn = functools.partial(kernel.step_routed_auto, cfg, hops=hops)
+    st, inbox = fn(st, inbox, full, slots, jnp.asarray(True))
+    jax.block_until_ready(st.commit)
+    c0 = int(np.asarray(st.commit).max(axis=1).sum())
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        st, inbox = fn(st, inbox, full, slots, jnp.asarray(True))
+    jax.block_until_ready(st.commit)
+    dt = (time.perf_counter() - t0) / rounds * 1000.0
+    c1 = int(np.asarray(st.commit).max(axis=1).sum())
+    cps = (c1 - c0) / (rounds * dt / 1000.0)
+    print(f"G={G:>7} hops={hops}: {dt:6.2f} ms/round, "
+          f"{cps:,.0f} commits/s; propose->commit within one round, "
+          f"2-round worst case {2 * dt:.1f} ms")
+
+
+if __name__ == "__main__":
+    gs = [int(a) for a in sys.argv[1:]] or [12500, 32768, 100000]
+    print("backend:", jax.default_backend())
+    for g in gs:
+        measure(g)
