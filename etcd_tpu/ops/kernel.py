@@ -416,13 +416,11 @@ def _terms_at_many(st: GroupState, cfg: KernelConfig,
     """term_at for an extra trailing axis of indices: idx (G, P, E) ->
     terms (G, P, E); 0 outside the window / beyond last. The one-hot
     select-sum below IS the measured-fastest TPU formulation (it replaced
-    the take_along_axis gathers that originally dominated the round); an
-    explicit Pallas variant of this resolve was prototyped and removed —
-    it never demonstrated a win over the XLA fusion on real hardware, and
-    an unmeasured alternate on the hottest op is a liability, not an
-    option (r3 verdict). scripts/pallas_bench.py retains the standalone
-    harness to re-measure a Pallas candidate against this path before any
-    future reintroduction."""
+    the take_along_axis gathers that originally dominated the round). A
+    Pallas variant (ops/pallas_kernels.ring_resolve) was measured on real
+    TPU in r4: 2.3x faster in isolation but 9.3x SLOWER wired in here
+    (scripts/pallas_roundbench.py — the pallas_call boundary defeats the
+    fusion this formulation exists for), so the jnp path stays."""
     slot = jnp.mod(idx, cfg.window)
     t = ring_lookup(st.log_term, slot)
     last = st.last_index[..., None]

@@ -18,12 +18,18 @@ holding each block's ring rows (BR, W) and index rows (BR, TE) in VMEM
 and computing the masked one-hot contraction in one pass — no HBM
 intermediates regardless of how XLA would schedule the jnp version.
 
-This module is a MEASUREMENT CANDIDATE, not a production path: the
-r3 verdict's measure-or-delete call removed the runtime flag that could
-route the hot kernel through it unmeasured. scripts/pallas_bench.py
-benchmarks it against the production one-hot path per backend; only a
-demonstrated TPU win earns it a call site. On CPU it runs in interpret
-mode (tests pin its windowed-resolve semantics).
+This module is a MEASURED-AND-REJECTED candidate, kept as the harness
+for any future re-measurement: on real TPU v5 lite (2026-07-31,
+G=100k P=5 W=16 E=4) the isolated op wins 2.3x over the jnp one-hot
+path (0.022 ms vs 0.051 ms, scripts/pallas_bench.py), but wired into
+`_terms_at_many` of the full hops=3 kernel round it LOSES 9.3x
+(165.6 ms/round vs 17.7 ms, scripts/pallas_roundbench.py): the
+pallas_call boundary blocks XLA from fusing the resolve into the
+surrounding message-assembly ops, so every call site pays HBM
+round-trips for operands the fused program never materializes. The
+jnp path stays production; do not give this a call site without
+beating scripts/pallas_roundbench.py first. On CPU it runs in
+interpret mode (tests pin its windowed-resolve semantics).
 """
 from __future__ import annotations
 

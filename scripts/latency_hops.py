@@ -30,6 +30,9 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from etcd_tpu.ops import kernel  # noqa: E402
 from etcd_tpu.ops.state import KernelConfig, init_state  # noqa: E402
+from etcd_tpu.utils.platform import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 
 def measure(G: int, hops: int = 3, peers: int = 5, rounds: int = 80):
