@@ -328,7 +328,8 @@ class TenantAPI:
             "pending_payloads": len(eng.payloads),
         }
         # Multi-host engines expose their catch-up counters too.
-        for k in ("pulls_sent", "payloads_pulled", "pay_frames_dropped"):
+        for k in ("pulls_sent", "payloads_pulled", "pay_frames_dropped",
+                  "snaps_sent", "snaps_installed"):
             v = getattr(eng, k, None)
             if v is not None:
                 out[k] = v

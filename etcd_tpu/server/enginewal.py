@@ -90,10 +90,17 @@ class RoundRecord:
     entries: List[Tuple[int, int, int, bytes]] = field(default_factory=list)
     # (g, slot, op) membership bit flips applied this round:
     confs: List[Tuple[int, int, int]] = field(default_factory=list)
+    # (g, applied_index, store_blob) cross-host snapshot installs received
+    # this round (hostengine): the store jumps wholesale to the blob's state
+    # at applied_index. The same round's hs/ring/last diffs carry the
+    # install's column surgery (mirrors are kept stale through it), so this
+    # section records only what the diffs cannot: the state-machine image
+    # and the apply cursor. Replayed FIRST within the record.
+    snaps: List[Tuple[int, int, bytes]] = field(default_factory=list)
 
     def is_empty(self) -> bool:
         return not (len(self.hs_g) or len(self.last_g) or len(self.ring_g)
-                    or self.entries or self.confs)
+                    or self.entries or self.confs or self.snaps)
 
     def encode(self) -> bytes:
         out = [struct.pack("<I", self.round_no)]
@@ -118,6 +125,15 @@ class RoundRecord:
         out.append(struct.pack("<I", len(self.confs)))
         for g, slot, op in self.confs:
             out.append(struct.pack("<IHB", g, slot, op))
+        # Trailing section, appended only when used: records written before
+        # snapshots existed simply end here, and decode treats the missing
+        # section as empty (same forward-compat trick a protobuf field
+        # addition gives the reference's walpb).
+        if self.snaps:
+            out.append(struct.pack("<I", len(self.snaps)))
+            for g, a, blob in self.snaps:
+                out.append(struct.pack("<III", g, a, len(blob)))
+                out.append(blob)
         return b"".join(out)
 
     @staticmethod
@@ -158,6 +174,13 @@ class RoundRecord:
             g, slot, op = struct.unpack_from("<IHB", b, off)
             off += 7
             rec.confs.append((g, slot, op))
+        if off < len(b):
+            n_snaps = u32()
+            for _ in range(n_snaps):
+                g, a, ln = struct.unpack_from("<III", b, off)
+                off += 12
+                rec.snaps.append((g, a, b[off:off + ln]))
+                off += ln
         return rec
 
 
@@ -299,6 +322,31 @@ class EngineWAL:
             except (ValueError, OSError):
                 os.replace(path, path + ".broken")
         return -1, None
+
+
+def load_terms(dirname: str, groups: int) -> np.ndarray:
+    """Final per-group term recorded in one host's engine WAL dir
+    (checkpoint base + round-record replay; terms are monotonic, so the
+    final value is also the max). The degraded-restart supervisor takes the
+    elementwise max of every SURVIVOR's result as the term floor for a host
+    restarting with an empty data dir: any vote the dead host ever cast in
+    a term above that floor can only have been a vote for itself (a
+    candidate's own term is persisted wherever it campaigns), so granting
+    fresh votes at floor+1 and up can never double-count toward a quorum
+    the old vote already joined."""
+    terms = np.zeros(groups, np.int32)
+    wal = EngineWAL(dirname)
+    try:
+        ckpt_round, ckpt = wal.load_checkpoint()
+        if ckpt is not None:
+            terms = b64_np(ckpt["term"]).astype(np.int32).copy()
+        for rec in wal.replay(after_round=ckpt_round):
+            for g, t in zip(rec.hs_g, rec.hs_term):
+                if g < groups:
+                    terms[g] = t
+    finally:
+        wal.close()
+    return terms
 
 
 def np_b64(a: np.ndarray) -> dict:

@@ -28,7 +28,13 @@ Crash model: a host crash stalls the synchronous collective, so the JOB
 restarts (all hosts), each replaying its own WAL — zero acked writes lost.
 Availability during a single-host outage is traded for the dense SPMD data
 plane; divergence from the reference's per-member liveness is documented
-in docs/divergences.md.
+in docs/divergences.md. The restart does NOT require the dead host's
+disk: a rank respawned with an EMPTY data dir (supervisor-written term
+floor fencing its lost votes — see _load_term_floor) rejoins as an empty
+follower and catches up through the cross-host snapshot-install path
+(_send_snapshots/_install_snaps, the rafthttp MsgSnap side-channel
+analogue, reference peer.go:250-252 + raft.go:246-260/671-713), so a
+single host loss — machine AND data — is survivable unattended.
 
 Proposal flow: a client hits ANY host; if the leader slot of the target
 group is local it stages directly (per-slot proposal counts are SHARDED
@@ -101,6 +107,12 @@ class HostEngineConfig:
     # reproducible soaks.
     drop_pay_pct: float = 0.0
     fault_seed: int = 0
+    # Cross-host snapshot install (the rafthttp snapshot side-channel,
+    # reference peer.go:250-252): per-(group, target) resend holdoff and a
+    # per-round cap on shipped images (bounds frame bytes and round time
+    # during a mass catch-up, e.g. a host restarting with an empty disk).
+    snap_interval: float = 1.0
+    snaps_per_round: int = 128
 
 
 class HostEngine:
@@ -187,6 +199,15 @@ class HostEngine:
         self.pay_frames_dropped = 0
         self.pulls_sent = 0
         self.payloads_pulled = 0
+        # Cross-host snapshot install state: staged inbound installs
+        # (g -> newest (a, term, lead, ring_row, store_blob)), records to
+        # journal this round, per-(g, target) send holdoff, counters.
+        self._pending_snaps: Dict[int, Tuple[int, int, int, np.ndarray,
+                                             bytes]] = {}
+        self._snap_recs: List[Tuple[int, int, bytes]] = []
+        self._snap_sent: Dict[Tuple[int, int], float] = {}
+        self.snaps_sent = 0
+        self.snaps_installed = 0
 
         self.frames = FrameTransport(
             cfg.host_id, cfg.frame_listen, cfg.frame_peers,
@@ -196,8 +217,9 @@ class HostEngine:
         ckpt_round, ckpt = self.wal.load_checkpoint()
         recs = list(self.wal.replay(after_round=ckpt_round))
         base = init_state(self.kcfg, stagger=cfg.stagger)
-        if ckpt is not None or recs:
-            self._restore(base, ckpt_round, ckpt, recs)
+        floor = self._load_term_floor() if ckpt is None else None
+        if ckpt is not None or recs or floor is not None:
+            self._restore(base, ckpt_round, ckpt, recs, floor)
         else:
             self.st = shard_state(base, self.mesh)
         inbox0 = jnp.zeros((G, Pn, Pn, self.kcfg.fields), jnp.int32)
@@ -241,13 +263,44 @@ class HostEngine:
 
         return jax.make_array_from_callback(base_np.shape, sh, cb)
 
+    def _load_term_floor(self) -> Optional[np.ndarray]:
+        """Per-group term floor written by the degraded-restart supervisor
+        into an EMPTY data dir (this host's disk was lost with the host):
+        the elementwise max of every survivor's recorded terms. Booting at
+        the floor fences the lost vote records — any vote the dead
+        incarnation cast in a term above all survivors' terms can only
+        have been a self-vote (a candidate persists its own term wherever
+        it campaigns), which can never complete a quorum now that the
+        incarnation is gone; all fresh votes happen at floor+1 and above.
+        Ignored once a checkpoint exists (the checkpoint carries full
+        term state recorded while the floor was in effect)."""
+        import os
+        path = os.path.join(self.cfg.data_dir, "term_floor.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            floor = np.asarray(json.load(f)["term"], np.int32)
+        if floor.shape != (self.cfg.groups,):
+            raise ValueError(
+                f"term_floor.json has {floor.shape[0]} groups, "
+                f"engine has {self.cfg.groups}")
+        log.info("host %d: booting with a term floor (max %d) from the "
+                 "degraded-restart supervisor", self.my_slot,
+                 int(floor.max(initial=0)))
+        return floor
+
     def _restore(self, base, ckpt_round: int, ckpt: Optional[dict],
-                 recs: List[RoundRecord]) -> None:
+                 recs: List[RoundRecord],
+                 floor: Optional[np.ndarray] = None) -> None:
         """Rebuild THIS host's column from its checkpoint + WAL replay;
         every slot restarts as a follower (reference RestartNode)."""
         from etcd_tpu.parallel.mesh import shard_state
         G, W = self.cfg.groups, self.cfg.window
 
+        if floor is not None:
+            # Base for diff replay: WAL records after a floor boot were
+            # diffs against floor-initialized mirrors.
+            self.l_term = floor.copy()
         if ckpt is not None:
             self.l_term = b64_np(ckpt["term"]).astype(np.int32)
             self.l_vote = b64_np(ckpt["vote"]).astype(np.int32)
@@ -281,6 +334,13 @@ class HostEngine:
         last_round = ckpt_round
         for rec in recs:
             last_round = max(last_round, rec.round_no)
+            # Snapshot installs first: the same record's hs/ring/last diffs
+            # were computed AFTER the install surgery and land on top.
+            for g, a, blob in rec.snaps:
+                s = new_store(namespaces=("/0", "/1"))
+                s.recovery(blob)
+                self._stores[int(g)] = s
+                self.applied[int(g)] = a
             for g, t_, v_, c_ in zip(rec.hs_g, rec.hs_term, rec.hs_vote,
                                      rec.hs_commit):
                 self.l_term[g] = t_
@@ -391,8 +451,190 @@ class HostEngine:
                         if (self._missing.pop(key, None) is not None
                                 and is_pull_resp):
                             self.payloads_pulled += 1
+                elif t == "snap":
+                    for g, a, t_s, lead, row, image in _unpack_snaps(
+                            blob, self.cfg.window):
+                        if not 0 <= g < G:
+                            raise ValueError(f"group {g} out of range")
+                        cur = self._pending_snaps.get(g)
+                        if cur is None or (t_s, a) > (cur[1], cur[0]):
+                            self._pending_snaps[g] = (a, t_s, lead, row,
+                                                      image)
             except Exception:  # noqa: BLE001 — drop the frame, keep serving
                 log.exception("bad frame from host %d dropped", frm)
+
+    # ------------------------------------------------------------------
+    # cross-host snapshot install (the rafthttp snapshot side-channel)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _local(arr) -> np.ndarray:
+        """This host's shard (our peer-slot column) of a global array."""
+        return np.asarray(list(arr.addressable_shards)[0].data)
+
+    def _set_local(self, name: str, block: np.ndarray):
+        """New global array for state field `name` whose LOCAL shard (our
+        peer-slot column) is `block` — shape (G, 1, ...). Purely local:
+        every process only ever materializes its own shards, so no
+        collective is involved (same pattern as the need_host clearing)."""
+        jax = self._jax
+        sh = getattr(self._st_sh, name)
+        gshape = (block.shape[0], self.cfg.peers) + block.shape[2:]
+        blk = np.ascontiguousarray(block)
+        return jax.make_array_from_callback(gshape, sh, lambda idx: blk)
+
+    def _install_snaps(self) -> None:
+        """Receive half of the cross-host MsgSnap flow (reference
+        raft.go:671-713 restore; single-host twin _service_need_host):
+        surgically move OUR column of each staged group to the shipped
+        image — term/ring/last/commit jump to the install point, the store
+        is recovered wholesale, and the apply cursor follows. Runs BEFORE
+        the round's collective so the step already sees the new state; the
+        same round's WAL record carries both the store image (rec.snaps)
+        and, via the stale l_* mirrors, the column surgery — fsynced in
+        phase 5 before anything is acked on top."""
+        G, Pn, W = self.cfg.groups, self.cfg.peers, self.cfg.window
+        st = self.st
+        local = self._local
+        term = local(st.term).copy()         # (G, 1)
+        vote = local(st.vote).copy()
+        commit = local(st.commit).copy()
+        last = local(st.last_index).copy()
+        ring = local(st.log_term).copy()     # (G, 1, W)
+        state = local(st.state).copy()
+        lead = local(st.lead).copy()
+        elapsed = local(st.elapsed).copy()
+        touched = False
+        for g, (a, t_s, lead_slot, row, image) in \
+                self._pending_snaps.items():
+            # Stale or duplicate: we are not actually behind the image, or
+            # the sender's term has been superseded — drop (the reference's
+            # restore ignores snapshots at-or-below commit, raft.go:676).
+            if a <= int(commit[g, 0]) or t_s < int(term[g, 0]):
+                continue
+            # Recover the store FIRST: a corrupt image (truncated frame, a
+            # buggy peer) must reject this group's install wholesale, not
+            # kill the engine loop with the column already surgered — the
+            # malformed-frame invariant from _drain_frames extends here.
+            s = new_store(namespaces=("/0", "/1"))
+            try:
+                s.recovery(image)
+            except Exception:  # noqa: BLE001 — reject the image, keep going
+                log.exception("host %d: rejecting corrupt snapshot image "
+                              "g=%d index=%d from slot %d", self.my_slot,
+                              g, a, lead_slot)
+                continue
+            if t_s > int(term[g, 0]):
+                vote[g, 0] = 0
+            term[g, 0] = t_s
+            ring[g, 0, :] = row
+            last[g, 0] = a
+            commit[g, 0] = a
+            state[g, 0] = 0
+            lead[g, 0] = lead_slot + 1
+            elapsed[g, 0] = 0
+            self._stores[g] = s
+            self.applied[g] = a
+            # The apply cursor jumped: pending pulls for entries at or
+            # below the install point can never be answered (they fell
+            # below every window — that is WHY a snapshot was needed) and
+            # would otherwise occupy the pull budget forever.
+            for k in [k for k in self._missing if k[0] == g and k[1] <= a]:
+                del self._missing[k]
+            self._snap_recs.append((g, a, image))
+            self.snaps_installed += 1
+            touched = True
+            log.info("host %d: installed snapshot g=%d index=%d term=%d "
+                     "from slot %d", self.my_slot, g, a, t_s, lead_slot)
+        self._pending_snaps.clear()
+        if not touched:
+            return
+        # l_* mirrors deliberately stay PRE-surgery: phase 4's diff against
+        # them journals the install's term/vote/commit/last/ring changes.
+        self.st = st._replace(
+            term=self._set_local("term", term),
+            vote=self._set_local("vote", vote),
+            commit=self._set_local("commit", commit),
+            last_index=self._set_local("last_index", last),
+            log_term=self._set_local("log_term", ring),
+            state=self._set_local("state", state),
+            lead=self._set_local("lead", lead),
+            elapsed=self._set_local("elapsed", elapsed))
+
+    def _send_snapshots(self, flagged: np.ndarray, st):
+        """Leader half of the cross-host MsgSnap flow (reference
+        raft.go:246-260 sendAppend->MsgSnap + the rafthttp pipeline
+        side-channel, peer.go:250-252): for each flagged group we lead,
+        ship (store image @ our apply cursor a, ring row masked above a,
+        term/lead metadata) to every slot whose needed entries fell below
+        our ring window, then optimistically probe at a+1. `match` is NOT
+        advanced — quorum commit only ever rides real acks — so a lost
+        frame or a dead receiver just re-fires need_snap after the
+        holdoff: self-healing without a ReportSnapshot protocol. Returns
+        the (possibly progress-surgered) state."""
+        W = self.cfg.window
+        Pn = self.cfg.peers
+        now = time.time()
+        local = self._local
+        nxt = local(st.next).copy()          # (G, 1, P)
+        by_host: Dict[int, List[Tuple[int, int, int, int, np.ndarray,
+                                      bytes]]] = {}
+        surgery = []
+        budget = self.cfg.snaps_per_round
+        for g in flagged:
+            g = int(g)
+            if budget <= 0:
+                break
+            if self.l_state[g] != _LEADER:
+                continue
+            a = int(self.applied[g])
+            lastv = int(self.l_last[g])
+            # The probe after install sends from a+1, whose previous-entry
+            # term (index a) must still be in OUR ring: if our applier is
+            # further behind than the window reaches back, retry next
+            # holdoff once it catches up.
+            if a < 1 or a <= lastv - W:
+                continue
+            row = image = None
+            for f in range(Pn):
+                if f == self.my_slot or budget <= 0:
+                    continue
+                if int(nxt[g, 0, f]) > lastv - W:
+                    continue                   # reachable by appends
+                if now - self._snap_sent.get((g, f), 0.0) \
+                        < self.cfg.snap_interval:
+                    continue
+                if image is None:
+                    image = self.store(g).save()
+                    row = self.l_ring[g].copy()
+                    for w in range(W):
+                        if lastv - ((lastv - w) % W) > a:
+                            row[w] = 0
+                self._snap_sent[(g, f)] = now
+                by_host.setdefault(f, []).append(
+                    (g, a, int(self.l_term[g]), self.my_slot, row, image))
+                surgery.append((g, f, a))
+                budget -= 1
+                self.snaps_sent += 1
+        for f, snaps in by_host.items():
+            self.frames.send(f, {"t": "snap"}, _pack_snaps(snaps))
+        if not surgery:
+            return st
+        prs = local(st.pr_state).copy()      # (G, 1, P)
+        pau = local(st.paused).copy()
+        age = local(st.ack_age).copy()
+        for g, f, a in surgery:
+            nxt[g, 0, f] = a + 1
+            prs[g, 0, f] = 0                 # PR_PROBE
+            pau[g, 0, f] = False
+            age[g, 0, f] = 0
+        log.info("host %d: sent %d snapshot installs (%d groups flagged)",
+                 self.my_slot, len(surgery), len(flagged))
+        return st._replace(
+            next=self._set_local("next", nxt),
+            pr_state=self._set_local("pr_state", prs),
+            paused=self._set_local("paused", pau),
+            ack_age=self._set_local("ack_age", age))
 
     # ------------------------------------------------------------------
     # public API (same shape as MultiEngine where it makes sense)
@@ -541,6 +783,8 @@ class HostEngine:
 
         # -- 1. frames in; stage local, forward remote --------------------
         self._drain_frames()
+        if self._pending_snaps:
+            self._install_snaps()
         cnt_local = np.zeros(G, np.int32)
         self._staged.clear()
         forwards: List[Tuple[int, int, List[Tuple[int, bytes]]]] = []
@@ -600,9 +844,7 @@ class HostEngine:
         self.inbox = inbox
 
         # -- 3. read back OUR column --------------------------------------
-        def local(a):
-            return np.asarray(list(a.addressable_shards)[0].data)
-
+        local = self._local
         term = local(st.term)[:, 0]
         vote = local(st.vote)[:, 0]
         commit = local(st.commit)[:, 0]
@@ -613,28 +855,27 @@ class HostEngine:
         need_host = local(st.need_host)[:, 0]
 
         if need_host.any():
-            from etcd_tpu.ops.state import NH_VIOLATION
+            from etcd_tpu.ops.state import NH_SNAP, NH_VIOLATION
             viol = (need_host & NH_VIOLATION) != 0
             if viol.any():
                 raise RuntimeError(
                     f"host {self.my_slot}: consensus safety violation in "
                     f"groups {np.nonzero(viol)[0][:8].tolist()}")
-            # NH_SNAP across hosts: catch-up beyond the ring window needs
-            # a cross-host snapshot protocol; the synchronous collective
-            # loses no messages, so this only fires after pathological
-            # restarts. Loud, not fatal.
-            log.warning("host %d: need_host(NH_SNAP) flags on %d groups "
-                        "(cross-host snapshot install not implemented)",
-                        self.my_slot, int((need_host != 0).sum()))
+            # NH_SNAP: a target's needed entries fell below our ring
+            # window — only possible after a peer host restarted with a
+            # stale or empty WAL (the synchronous collective itself loses
+            # nothing). Ship store images + probe (leader side of MsgSnap).
+            snap_g = np.nonzero((need_host & NH_SNAP) != 0)[0]
+            if len(snap_g):
+                st = self._send_snapshots(snap_g, st)
             # Consume the flags: the kernel only ORs NH_* bits, so without
             # a write-back one event would re-log every round forever and
             # mask later flags. Each host zeroes ITS column shard (purely
             # local data, no collective — mirrors the single-host
-            # _service_need_host clearing).
-            jax = self._jax
-            st = st._replace(need_host=jax.make_array_from_callback(
-                (G, Pn), self._st_sh.need_host,
-                lambda idx: np.zeros((G, 1), np.int32)))
+            # _service_need_host clearing). Re-fire is guaranteed while the
+            # lag persists (the kernel recomputes need_snap every round).
+            st = st._replace(need_host=self._set_local(
+                "need_host", np.zeros((G, 1), np.int32)))
             self.st = st
 
         # -- 4. durable record for OUR column -----------------------------
@@ -688,6 +929,11 @@ class HostEngine:
         # Payloads learned from peers this round are journaled too: an ack
         # we later issue from their application must survive OUR restart.
         rec.entries.extend(self._fresh_payloads)
+        # Snapshot installs received this round: the store image + cursor
+        # ride the same record (and fsync) as the column surgery's diffs.
+        if self._snap_recs:
+            rec.snaps = self._snap_recs
+            self._snap_recs = []
 
         self.l_term, self.l_vote, self.l_commit = term, vote, commit
         self.l_state, self.l_last, self.l_ring = state, last, ring
@@ -885,6 +1131,11 @@ class HostEngine:
                 and k[1] <= self.l_last[k[0]] - W]
         for k in dead:
             del self.payloads[k]
+        # Snapshot-send holdoffs are only meaningful for ~snap_interval;
+        # prune stale ones so a mass catch-up doesn't leave G*P tombstones.
+        cutoff = time.time() - 60.0
+        for k in [k for k, t0 in self._snap_sent.items() if t0 < cutoff]:
+            del self._snap_sent[k]
 
 
 # ---------------------------------------------------------------------------
@@ -927,5 +1178,40 @@ def _unpack_payloads(blob: bytes) -> List[Tuple[int, int, int, bytes]]:
         g, i, t, ln = struct.unpack_from("<IIII", blob, off)
         off += 16
         out.append((g, i, t, blob[off:off + ln]))
+        off += ln
+    return out
+
+
+def _pack_snaps(snaps: List[Tuple[int, int, int, int, np.ndarray,
+                                  bytes]]) -> bytes:
+    """(g, install_index, term, lead_slot, ring_row[W], store_image)."""
+    out = [struct.pack("<I", len(snaps))]
+    for g, a, t, lead, row, image in snaps:
+        out.append(struct.pack("<IIIH", g, a, t, lead))
+        out.append(np.ascontiguousarray(row.astype("<i4")).tobytes())
+        out.append(struct.pack("<I", len(image)))
+        out.append(image)
+    return b"".join(out)
+
+
+def _unpack_snaps(blob: bytes, window: int
+                  ) -> List[Tuple[int, int, int, int, np.ndarray, bytes]]:
+    (n,) = struct.unpack_from("<I", blob, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        g, a, t, lead = struct.unpack_from("<IIIH", blob, off)
+        off += 14
+        row = np.frombuffer(blob, "<i4", count=window,
+                            offset=off).astype(np.int32)
+        off += 4 * window
+        (ln,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        if off + ln > len(blob):
+            # A silently truncated store image must fail HERE, inside the
+            # drain-time per-frame try, not later in the install path.
+            raise ValueError(f"snap frame truncated: image needs {ln} "
+                             f"bytes, {len(blob) - off} remain")
+        out.append((g, a, t, lead, row, blob[off:off + ln]))
         off += ln
     return out

@@ -52,6 +52,8 @@ def run_rank() -> int:
         host_id=rank,
         frame_listen=("127.0.0.1", frame_ports[rank]),
         frame_peers={h: ("127.0.0.1", frame_ports[h]) for h in range(n)},
+        window=int(os.environ.get("MHE_WINDOW", "32")),
+        max_ents=int(os.environ.get("MHE_MAX_ENTS", "8")),
         fsync=os.environ.get("MHE_FSYNC", "1") == "1",
         request_timeout=float(os.environ.get("MHE_REQ_TIMEOUT", "20")),
         round_interval=float(os.environ.get("MHE_ROUND_INTERVAL", "0")),

@@ -78,7 +78,51 @@ class Supervisor:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def prepare_dirs(self) -> None:
+        """Degraded restart: if some rank's data dir vanished with its
+        machine while survivors still hold WAL data, write a per-group
+        TERM FLOOR (elementwise max of every survivor's recorded terms)
+        into a fresh dir for it. The respawned rank then boots empty-but-
+        fenced: any vote its dead incarnation cast in a term above the
+        floor can only have been a self-vote, which can never complete a
+        quorum now that the incarnation is gone — so granting fresh votes
+        from floor+1 up is safe under single host failure. The empty rank
+        rejoins as a follower and catches up through the engines'
+        cross-host snapshot-install path (hostengine._send_snapshots)."""
+        dirs = [os.path.join(self.data, f"host{r}") for r in range(self.n)]
+
+        def has_data(d):
+            if not os.path.isdir(d):
+                return False
+            return any(n.startswith(("engine-", "checkpoint-"))
+                       for n in os.listdir(d))
+
+        has = [has_data(d) for d in dirs]
+        if all(has) or not any(has):
+            return
+        import numpy as np
+        from etcd_tpu.server.enginewal import load_terms
+        floor = None
+        for d, h in zip(dirs, has):
+            if h:
+                t = load_terms(d, self.groups)
+                floor = t if floor is None else np.maximum(floor, t)
+        for r, (d, h) in enumerate(zip(dirs, has)):
+            if h:
+                continue
+            os.makedirs(d, exist_ok=True)
+            tmp = os.path.join(d, "term_floor.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"term": [int(x) for x in floor]}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(d, "term_floor.json"))
+            print(f"supervisor: rank {r} data dir is empty — wrote term "
+                  f"floor (max {int(floor.max(initial=0))}) from "
+                  f"survivors for a degraded restart", flush=True)
+
     def spawn(self) -> None:
+        self.prepare_dirs()
         coord = f"127.0.0.1:{free_port()}"
         self.generation += 1
         self.procs = []

@@ -1,0 +1,280 @@
+"""Cross-host snapshot install + degraded restart (VERDICT r3 missing #1,
+the "better" option): a host dies taking its DISK with it, and the job
+still recovers unattended — the supervisor writes a term floor from the
+survivors' WALs into a fresh dir (fencing the lost vote records), the
+respawned rank rejoins empty, and the leaders ship store images over the
+frame transport (hostengine._send_snapshots / _install_snaps — the
+reference's MsgSnap + rafthttp snapshot side-channel, raft.go:246-260,
+671-713, peer.go:250-252). The reference survives member disk loss only
+by operator-driven member replace; here it is automatic.
+
+Fast sections test the WAL snap records and the term-floor math without
+jax; the slow test drives the whole story through the supervisor.
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUP = os.path.join(REPO, "scripts", "multihost_supervisor.py")
+
+
+# ---------------------------------------------------------------------------
+# RoundRecord.snaps + load_terms (no jax)
+# ---------------------------------------------------------------------------
+
+def test_roundrecord_snaps_roundtrip():
+    from etcd_tpu.server.enginewal import RoundRecord
+    rec = RoundRecord(round_no=9,
+                      entries=[(1, 2, 3, b"pay")],
+                      snaps=[(4, 17, b"STORE-IMAGE"), (5, 1, b"")])
+    out = RoundRecord.decode(rec.encode())
+    assert out.snaps == [(4, 17, b"STORE-IMAGE"), (5, 1, b"")]
+    assert out.entries == [(1, 2, 3, b"pay")]
+    assert not rec.is_empty()
+    assert RoundRecord(round_no=1, snaps=[(0, 1, b"z")]).is_empty() is False
+
+
+def test_roundrecord_pre_snaps_format_decodes():
+    """Records written before the snaps section existed end at confs;
+    decode must treat the missing trailing section as empty."""
+    from etcd_tpu.server.enginewal import RoundRecord
+    rec = RoundRecord(round_no=3,
+                      hs_g=np.array([2], "<u4"), hs_p=np.array([0], "<u2"),
+                      hs_term=np.array([5], "<u4"),
+                      hs_vote=np.array([1], "<u2"),
+                      hs_commit=np.array([4], "<u4"),
+                      confs=[(2, 1, 0)])
+    out = RoundRecord.decode(rec.encode())   # encode omits empty snaps
+    assert out.snaps == []
+    assert list(out.hs_term) == [5] and out.confs == [(2, 1, 0)]
+
+
+def test_load_terms_checkpoint_plus_replay(tmp_path):
+    from etcd_tpu.server.enginewal import (EngineWAL, RoundRecord,
+                                           load_terms, np_b64)
+    d = str(tmp_path / "hostX")
+    wal = EngineWAL(d, fsync=False)
+    wal.save_checkpoint(10, {
+        "term": np_b64(np.array([3, 1, 0, 7], np.int32)),
+        "vote": np_b64(np.zeros(4, np.int32)),
+        "commit": np_b64(np.zeros(4, np.int32)),
+        "last": np_b64(np.zeros(4, np.int32)),
+        "ring": np_b64(np.zeros((4, 8), np.int32)),
+        "applied": np_b64(np.zeros(4, np.int64)),
+        "stores": {}, "payloads": []})
+    list(wal.replay())  # position the writer after the checkpoint
+    # Terms move on groups 1 and 2 after the checkpoint.
+    wal.append(RoundRecord(round_no=11,
+                           hs_g=np.array([1, 2], "<u4"),
+                           hs_p=np.array([0, 0], "<u2"),
+                           hs_term=np.array([6, 2], "<u4"),
+                           hs_vote=np.array([0, 0], "<u2"),
+                           hs_commit=np.array([0, 0], "<u4")))
+    wal.close()
+    got = load_terms(d, 4)
+    assert got.tolist() == [3, 6, 2, 7]
+
+
+def test_supervisor_prepare_dirs_writes_floor(tmp_path):
+    """Two survivor dirs with different terms -> the missing rank's fresh
+    dir gets the elementwise max as its term floor."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from etcd_tpu.server.enginewal import EngineWAL, RoundRecord
+    import importlib
+    sup_mod = importlib.import_module("multihost_supervisor")
+    data = str(tmp_path)
+    for r, terms in ((0, [5, 2]), (1, [4, 9])):
+        d = os.path.join(data, f"host{r}")
+        wal = EngineWAL(d, fsync=False)
+        wal.append(RoundRecord(round_no=1,
+                               hs_g=np.array([0, 1], "<u4"),
+                               hs_p=np.array([r, r], "<u2"),
+                               hs_term=np.array(terms, "<u4"),
+                               hs_vote=np.array([0, 0], "<u2"),
+                               hs_commit=np.array([0, 0], "<u4")))
+        wal.close()
+    sup = sup_mod.Supervisor(3, 2, data, os.path.join(data, "s.json"),
+                             stall_s=5.0, poll_s=0.5)
+    sup.prepare_dirs()
+    with open(os.path.join(data, "host2", "term_floor.json")) as f:
+        floor = json.load(f)["term"]
+    assert floor == [5, 9]
+    # Survivors' dirs are untouched.
+    assert not os.path.exists(os.path.join(data, "host0",
+                                           "term_floor.json"))
+    # Idempotent boot case: nothing exists yet -> no floors invented.
+    empty = str(tmp_path / "fresh")
+    os.makedirs(empty)
+    sup2 = sup_mod.Supervisor(3, 2, empty, os.path.join(empty, "s.json"),
+                              stall_s=5.0, poll_s=0.5)
+    sup2.prepare_dirs()
+    assert not any(os.path.exists(os.path.join(empty, f"host{r}",
+                                               "term_floor.json"))
+                   for r in range(3))
+
+
+# ---------------------------------------------------------------------------
+# the whole story, end to end
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _put(url, body, timeout=25.0):
+    req = urllib.request.Request(
+        url, body, {"Content-Type": "application/x-www-form-urlencoded"},
+        method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _read_status(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _dump_rank_logs(data_dir):
+    for name in sorted(os.listdir(data_dir)):
+        if name.startswith("rank") and name.endswith(".log"):
+            p = os.path.join(data_dir, name)
+            with open(p, errors="replace") as f:
+                tail = f.read()[-4000:]
+            print(f"\n===== {name} =====\n{tail}", file=sys.stderr)
+
+
+GROUPS = 4
+WINDOW = 8
+VICTIM = 2
+
+
+@pytest.mark.slow
+def test_host_loss_with_disk_loss_recovers_via_snapshots(tmp_path):
+    data = str(tmp_path / "mhe")
+    os.makedirs(data)
+    status_path = os.path.join(data, "supervisor.json")
+    env = dict(os.environ, MHE_NHOSTS="3", MHE_GROUPS=str(GROUPS),
+               MHE_WINDOW=str(WINDOW), MHE_DATA=data,
+               MHE_STATUS=status_path, MHE_STALL_S="5.0",
+               MHE_MAX_RECOVERIES="1", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    sup = subprocess.Popen([sys.executable, SUP], env=env)
+    try:
+        deadline = time.time() + 240
+        st = None
+        while time.time() < deadline:
+            st = _read_status(status_path)
+            if st and st["state"] == "serving":
+                break
+            if sup.poll() is not None:
+                _dump_rank_logs(data)
+                pytest.fail(f"supervisor exited rc={sup.returncode} "
+                            f"during boot")
+            time.sleep(0.5)
+        else:
+            _dump_rank_logs(data)
+            pytest.fail("job never became healthy")
+        ports = st["http_ports"]
+
+        # Push every group's log PAST the ring window so a from-empty
+        # rejoin cannot be served by appends or payload pulls — only the
+        # cross-host snapshot path can bridge it.
+        writes = WINDOW + 6
+        for g in range(GROUPS):
+            for i in range(writes):
+                code, _ = _put(f"http://127.0.0.1:{ports[i % 3]}"
+                               f"/tenants/{g}/v2/keys/k{i}",
+                               f"value=g{g}i{i}".encode())
+                assert code in (200, 201)
+
+        # The host dies AND its disk dies with it.
+        victim_pid = st["pids"][str(VICTIM)]
+        os.kill(victim_pid, signal.SIGKILL)
+        shutil.rmtree(os.path.join(data, f"host{VICTIM}"))
+
+        # Unattended: detect -> term floor -> respawn -> snapshot rejoin.
+        deadline = time.time() + 300
+        rec = None
+        while time.time() < deadline:
+            st = _read_status(status_path)
+            if st and st["recoveries"]:
+                rec = st["recoveries"][0]
+                if st["state"] == "serving":
+                    break
+            if sup.poll() is not None and not (st and st["recoveries"]):
+                _dump_rank_logs(data)
+                pytest.fail(f"supervisor died (rc={sup.returncode}) "
+                            f"without recording a recovery")
+            time.sleep(0.5)
+        if rec is None or st["state"] != "serving":
+            _dump_rank_logs(data)
+            pytest.fail(f"no completed recovery (status={st})")
+        assert rec["ok"], rec
+        assert os.path.exists(os.path.join(data, f"host{VICTIM}",
+                                           "term_floor.json"))
+
+        # Service is back: new writes ack through every rank.
+        for g in range(GROUPS):
+            code, _ = _put(f"http://127.0.0.1:{ports[g % 3]}"
+                           f"/tenants/{g}/v2/keys/post", b"value=after")
+            assert code in (200, 201)
+
+        # The fresh rank's state machines converge to the survivors' via
+        # snapshot installs + payload pulls.
+        deadline = time.time() + 120
+        caught_up = False
+        while time.time() < deadline:
+            try:
+                sv = _get(f"http://127.0.0.1:{ports[VICTIM]}"
+                          f"/engine/status")
+                s0 = _get(f"http://127.0.0.1:{ports[0]}/engine/status")
+            except Exception:  # noqa: BLE001 — transient while settling
+                time.sleep(0.5)
+                continue
+            if (sv.get("snaps_installed", 0) >= GROUPS
+                    and sv["applied_total"] >= s0["applied_total"] - GROUPS):
+                caught_up = True
+                break
+            time.sleep(0.5)
+        if not caught_up:
+            _dump_rank_logs(data)
+            pytest.fail(f"victim never caught up: victim={sv} peer={s0}")
+        assert sv.get("snaps_installed", 0) >= GROUPS, sv
+
+        # Pre-kill acked data is readable from the REBUILT rank's own
+        # store (local read — no forwarding can mask a hole).
+        for g in range(GROUPS):
+            got = _get(f"http://127.0.0.1:{ports[VICTIM]}"
+                       f"/tenants/{g}/v2/keys/k0", timeout=25)
+            assert got["node"]["value"] == f"g{g}i0", (g, got)
+        print(f"disk-loss recovery: total {rec['total_s']}s, victim "
+              f"snaps_installed={sv['snaps_installed']}", file=sys.stderr)
+    except Exception:
+        _dump_rank_logs(data)
+        raise
+    finally:
+        sup.terminate()
+        try:
+            sup.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            sup.kill()
+        st = _read_status(status_path)
+        if st:
+            for pid in st.get("pids", {}).values():
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
