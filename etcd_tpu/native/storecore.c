@@ -716,6 +716,90 @@ result3(PyObject *nd, PyObject *pd, uint64_t index)
 
 /* --------------------------------------------------------------- set op */
 
+/* The SET mutation body shared by Core_set and Core_set_many: applies one
+ * set, records history, and hands back new-owned nd/pd descriptors.
+ * Returns the new index, or 0 with the Python error set (etcd errors AND
+ * fatal ones — callers distinguish via PyErr_GivenExceptionMatches). */
+static uint64_t
+set_apply(CoreObject *c, const char *path, Py_ssize_t plen,
+          const char *value, Py_ssize_t vlen, int is_dir, double expire,
+          double now, PyObject **nd_out, PyObject **pd_out)
+{
+    *nd_out = *pd_out = NULL;
+    if (core_is_readonly(c, path, plen)) {
+        c->stats[ST_SETS_FAIL]++;
+        raise_etcd(ECODE_ROOT_RONLY, "/", 1, c->current_index);
+        return 0;
+    }
+    uint64_t next = c->current_index + 1;
+    Py_ssize_t dlen, nlen;
+    const char *name;
+    split_dirname(path, plen, &dlen, &name, &nlen);
+    CNode *parent = core_make_dirs(c, path, dlen, next);
+    if (parent == NULL) {
+        c->stats[ST_SETS_FAIL]++;
+        return 0;
+    }
+    CNode *existing = cmap_get(parent->children, name, (uint32_t)nlen);
+    PyObject *prev = NULL;
+    if (existing != NULL) {
+        if (existing->children != NULL) {
+            /* set over a dir: 102 (with OR without dir=True) */
+            c->stats[ST_SETS_FAIL]++;
+            raise_etcd(ECODE_NOT_FILE, path, plen, c->current_index);
+            return 0;
+        }
+        prev = node_desc(existing);
+        if (prev == NULL)
+            return 0;
+    }
+    CNode *n;
+    if (existing != NULL && !is_dir) {
+        /* in-place replace: a SET is a brand-new node, both indices move */
+        if (node_set_value(existing, value ? value : "", value ? vlen : 0)
+                < 0) {
+            Py_DECREF(prev);
+            PyErr_NoMemory();
+            return 0;
+        }
+        existing->created = existing->modified = next;
+        existing->expire = expire;
+        n = existing;
+    } else {
+        if (existing != NULL) {
+            if (node_remove_rec(existing, NULL) < 0) {
+                Py_XDECREF(prev);
+                return 0;
+            }
+        }
+        n = node_new(path, (uint32_t)plen, next, next, parent, value, vlen,
+                     is_dir, expire);
+        if (n == NULL || cmap_add(parent->children, n) < 0) {
+            if (n)
+                node_decref(n);
+            Py_XDECREF(prev);
+            PyErr_NoMemory();
+            return 0;
+        }
+    }
+    if (heap_push(c, n) < 0) {
+        Py_XDECREF(prev);
+        PyErr_NoMemory();
+        return 0;
+    }
+    c->current_index = next;
+    c->stats[ST_SETS_OK]++;
+    PyObject *nd = node_desc(n);
+    if (nd == NULL) {
+        Py_XDECREF(prev);
+        return 0;
+    }
+    ring_push(c, ACT_SET, nd, prev, next, now);
+    *nd_out = nd;
+    *pd_out = prev;   /* may be NULL (no previous node) */
+    return next;
+}
+
 static PyObject *
 Core_set(CoreObject *c, PyObject *args)
 {
@@ -731,73 +815,98 @@ Core_set(CoreObject *c, PyObject *args)
     if (parse_value(value_o, &value, &vlen) < 0 ||
         parse_expire(expire_o, &expire) < 0)
         return NULL;
-    if (core_is_readonly(c, path, plen)) {
-        c->stats[ST_SETS_FAIL]++;
-        raise_etcd(ECODE_ROOT_RONLY, "/", 1, c->current_index);
+    PyObject *nd, *pd;
+    uint64_t next = set_apply(c, path, plen, value, vlen, is_dir, expire,
+                              now, &nd, &pd);
+    if (next == 0)
+        return NULL;
+    return result3(nd, pd, next);
+}
+
+/* Batched plain-file SETs for the engine apply loop (one GIL-atomic call
+ * per log-entry batch instead of one per request): paths/values are equal
+ * -length lists of str, no TTL, no dirs. Per-op etcd errors (e.g. set
+ * over a dir) fail THAT op exactly as the scalar call would — stats
+ * counted, index unmoved — and the batch continues; only fatal errors
+ * (OOM) abort. History ring records are produced per applied op, so
+ * watch scans and the facade's not-quiet re-notify see every event.
+ * Returns (first_index, last_index, n_failed, recs) — recs is a list of
+ * per-applied-op (nd, pd|None, index) when want_recs is true (so a
+ * watcher fan-out can notify without rescanning the ring — a batch
+ * larger than the ring capacity evicts its own earliest records), else
+ * None. first > last when nothing applied. */
+static PyObject *
+Core_set_many(CoreObject *c, PyObject *args)
+{
+    PyObject *paths, *vals;
+    double now;
+    int want_recs = 0;
+    if (!PyArg_ParseTuple(args, "O!O!d|p", &PyList_Type, &paths,
+                          &PyList_Type, &vals, &now, &want_recs))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(paths);
+    if (PyList_GET_SIZE(vals) != n) {
+        PyErr_SetString(PyExc_ValueError, "paths/values length mismatch");
         return NULL;
     }
-    uint64_t next = c->current_index + 1;
-    Py_ssize_t dlen, nlen;
-    const char *name;
-    split_dirname(path, plen, &dlen, &name, &nlen);
-    CNode *parent = core_make_dirs(c, path, dlen, next);
-    if (parent == NULL) {
-        c->stats[ST_SETS_FAIL]++;
-        return NULL;
-    }
-    CNode *existing = cmap_get(parent->children, name, (uint32_t)nlen);
-    PyObject *prev = NULL;
-    if (existing != NULL) {
-        if (existing->children != NULL) {
-            /* set over a dir: 102 (with OR without dir=True) */
-            c->stats[ST_SETS_FAIL]++;
-            raise_etcd(ECODE_NOT_FILE, path, plen, c->current_index);
-            return NULL;
-        }
-        prev = node_desc(existing);
-        if (prev == NULL)
+    PyObject *recs = NULL;
+    if (want_recs) {
+        recs = PyList_New(0);
+        if (recs == NULL)
             return NULL;
     }
-    CNode *n;
-    if (existing != NULL && !is_dir) {
-        /* in-place replace: a SET is a brand-new node, both indices move */
-        if (node_set_value(existing, value ? value : "", value ? vlen : 0)
-                < 0) {
-            Py_DECREF(prev);
-            return PyErr_NoMemory();
+    uint64_t first = c->current_index + 1;
+    Py_ssize_t failed = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_ssize_t plen, vlen;
+        const char *path = PyUnicode_AsUTF8AndSize(
+            PyList_GET_ITEM(paths, i), &plen);
+        if (path == NULL) {
+            Py_XDECREF(recs);
+            return NULL;
         }
-        existing->created = existing->modified = next;
-        existing->expire = expire;
-        n = existing;
-    } else {
-        if (existing != NULL) {
-            if (node_remove_rec(existing, NULL) < 0) {
-                Py_XDECREF(prev);
+        const char *value = PyUnicode_AsUTF8AndSize(
+            PyList_GET_ITEM(vals, i), &vlen);
+        if (value == NULL) {
+            Py_XDECREF(recs);
+            return NULL;
+        }
+        PyObject *nd, *pd;
+        uint64_t idx = set_apply(c, path, plen, value, vlen, 0, NAN, now,
+                                 &nd, &pd);
+        if (idx == 0) {
+            if (!PyErr_GivenExceptionMatches(PyErr_Occurred(), EtcdError)) {
+                Py_XDECREF(recs);
+                return NULL;       /* fatal (OOM etc.): abort the batch */
+            }
+            PyErr_Clear();
+            failed++;
+            continue;
+        }
+        if (recs != NULL) {
+            PyObject *rec = Py_BuildValue(
+                "(OOK)", nd, pd == NULL ? Py_None : pd,
+                (unsigned long long)idx);
+            if (rec == NULL || PyList_Append(recs, rec) < 0) {
+                Py_XDECREF(rec);
+                Py_DECREF(nd);
+                Py_XDECREF(pd);
+                Py_DECREF(recs);
                 return NULL;
             }
+            Py_DECREF(rec);
         }
-        n = node_new(path, (uint32_t)plen, next, next, parent, value, vlen,
-                     is_dir, expire);
-        if (n == NULL || cmap_add(parent->children, n) < 0) {
-            if (n)
-                node_decref(n);
-            Py_XDECREF(prev);
-            return PyErr_NoMemory();
-        }
+        Py_DECREF(nd);
+        Py_XDECREF(pd);
     }
-    if (heap_push(c, n) < 0) {
-        Py_XDECREF(prev);
-        return PyErr_NoMemory();
+    if (recs == NULL) {
+        recs = Py_None;
+        Py_INCREF(recs);
     }
-    c->current_index = next;
-    c->stats[ST_SETS_OK]++;
-    PyObject *nd = node_desc(n);
-    if (nd == NULL) {
-        Py_XDECREF(prev);
-        return NULL;
-    }
-    ring_push(c, ACT_SET, nd, prev, next, now);
-    return result3(nd, prev, next);
+    PyObject *out = Py_BuildValue("(KKnN)", (unsigned long long)first,
+                                  (unsigned long long)c->current_index,
+                                  failed, recs);
+    return out;
 }
 
 /* ------------------------------------------------------------ create op */
@@ -1732,6 +1841,10 @@ Core_dealloc(CoreObject *c)
 static PyMethodDef Core_methods[] = {
     {"set", (PyCFunction)Core_set, METH_VARARGS,
      "set(path, is_dir, value, expire) -> (desc, prev|None, index)"},
+    {"set_many", (PyCFunction)Core_set_many, METH_VARARGS,
+     "set_many(paths, values, now, want_recs=False) -> (first_index, "
+     "last_index, n_failed, recs|None); batched plain-file SETs, per-op "
+     "etcd errors skipped; recs = [(nd, pd|None, index)] when asked"},
     {"create", (PyCFunction)Core_create, METH_VARARGS,
      "create(path, is_dir, value, expire) -> (desc, None, index)"},
     {"update", (PyCFunction)Core_update, METH_VARARGS,

@@ -215,6 +215,51 @@ class NativeStore:
         hub.notify(e)
         return e
 
+    def set_applied_many(self, paths: List[str],
+                         values: List[str]) -> int:
+        """Batched plain-file PUTs for the engine apply loop: ONE
+        GIL-atomic C call applies the whole batch (per-op etcd errors fail
+        that op exactly like the scalar call — stats counted, index
+        unmoved — and the batch continues). History is recorded per op in
+        the C ring. Callers guarantee no waiter needs a per-op result
+        (those requests take set_applied).
+
+        Watchers: if any is live BEFORE the mutation, the C call collects
+        per-op records and every event is notified from them in order —
+        O(n), and immune to a batch larger than the history ring evicting
+        its own earliest records. A watcher that registers in the window
+        between the check and the GIL-atomic C call is caught by the
+        post-check and notified from the ring, clamped to what the ring
+        still holds — an event evicted by the same oversized batch is the
+        'fell behind the 1000-event history' case, which the next
+        waitIndex scan answers with 401 EventIndexCleared exactly like
+        the reference (store/event_history.go). Returns the number
+        applied."""
+        now = self.clock()
+        hub = self.watcher_hub
+        want_recs = not hub.quiet()
+        first, last, failed, recs = self._core.set_many(
+            [_norm(p) for p in paths], values, now, want_recs)
+        if last < first:
+            return len(paths) - failed
+        if recs is not None:
+            if not hub.quiet():
+                for nd, pd, idx in recs:
+                    hub.notify(Event(
+                        ev.SET, node=_extern(nd, now),
+                        prev_node=None if pd is None else _extern(pd, now),
+                        etcd_index=idx))
+        elif not hub.quiet():
+            # Registration raced the atomic batch; replay what the ring
+            # still holds (single pass over the clamped span).
+            lo = max(first, self._core.ring_bounds()[0])
+            scan = hub.event_history.scan
+            for i in range(lo, last + 1):
+                e = scan("/", True, i)
+                if e is not None and e.etcd_index <= last:
+                    hub.notify(e)
+        return len(paths) - failed
+
     # -- mutations -----------------------------------------------------------
 
     def set(self, node_path: str, is_dir: bool = False, value: str = "",

@@ -864,3 +864,91 @@ def test_engine_tenant_remove_recreate_same_record(tmp_path):
     with pytest.raises(_err.EtcdError):
         eng2.store(1).get("/old0", False, False)
     eng2.wal.close()
+
+
+def test_engine_batched_fast_path_mixed_entry(tmp_path):
+    """The C batched apply (store.set_applied_many) must be semantically
+    invisible: one coalesced P_MULTI entry mixing waiterless plain PUTs,
+    a waiter-held PUT, a CAS, and a TTL write applies in exact log order
+    with correct results, store state, stats, watch events, and replay."""
+    from etcd_tpu.store import HAVE_NATIVE_STORE
+    if not HAVE_NATIVE_STORE:
+        pytest.skip("native store core not built")
+    eng = MultiEngine(make_cfg(tmp_path / "fp", groups=4))
+    run_until(eng, lambda: eng.leader_slot(0) >= 0, msg="leader")
+    # Seed a key the CAS will hit, and a watcher that must see every write.
+    t, out = put_async(eng, 0, "/seed", "s0")
+    settle(eng, t, out)
+    w = eng.store(0).watch("/", recursive=True, stream=True,
+                           since_index=eng.store(0).current_index + 1)
+
+    # ONE round's staging coalesces everything queued for group 0 into a
+    # single P_MULTI entry: 3 waiterless PUTs + a CAS + a conditioned PUT
+    # + 2 more waiterless PUTs. Queue directly (no waiters registered for
+    # the plain ones — ids never enter Wait).
+    plain = []
+    with eng._lock:
+        for i in range(3):
+            r = Request(method="PUT", path=f"/fast{i}", val=f"f{i}",
+                        id=eng.reqid.next())
+            plain.append(r)
+            eng._pending[0].append((r.id, bytes([0]) + r.encode(), r))
+        eng._dirty.add(0)
+    t1, out1 = put_async(eng, 0, "/seed", "s1")   # waiter-held plain PUT
+    time.sleep(0.05)
+    cas = Request(method="PUT", path="/seed", prev_value="s1", val="s2",
+                  id=eng.reqid.next())
+    t2, out2 = (None, None)
+    with eng._lock:
+        q = eng.wait.register(cas.id)
+        eng._pending[0].append((cas.id, bytes([0]) + cas.encode(), cas))
+        for i in range(3, 5):
+            r = Request(method="PUT", path=f"/fast{i}", val=f"f{i}",
+                        id=eng.reqid.next())
+            plain.append(r)
+            eng._pending[0].append((r.id, bytes([0]) + r.encode(), r))
+        eng._dirty.add(0)
+    settle(eng, t1, out1)
+    assert out1["res"].node.value == "s1"
+    for _ in range(200):
+        if not q.empty():
+            break
+        eng.run_round()
+    cas_ev = q.get(timeout=5)
+    assert not isinstance(cas_ev, Exception), cas_ev
+    assert cas_ev.node.value == "s2"
+    eng._drain_applies()
+
+    # State: every fast-path PUT landed, in order, with distinct indices.
+    idxs = []
+    for i in range(5):
+        ev = eng.store(0).get(f"/fast{i}", False, False)
+        assert ev.node.value == f"f{i}"
+        idxs.append(ev.node.modified_index)
+    assert eng.store(0).get("/seed", False, False).node.value == "s2"
+
+    # The stream watcher saw every event (fast-path ones included).
+    seen = []
+    for _ in range(20):
+        e = w.next_event(timeout=2)
+        if e is None:
+            break
+        seen.append((e.action, e.node.key))
+        if len([1 for a, k in seen if k.startswith("/fast")]) == 5 \
+                and ("compareAndSwap", "/seed") in seen:
+            break
+    fast_seen = [k for a, k in seen if k.startswith("/fast")]
+    assert fast_seen == [f"/fast{i}" for i in range(5)], seen
+    assert ("compareAndSwap", "/seed") in seen, seen
+
+    # Replay parity: a fresh engine on the same WAL reconstructs the
+    # exact same store (the fast path also runs under trigger=False).
+    eng.stop()
+    eng2 = MultiEngine(make_cfg(tmp_path / "fp", groups=4))
+    for i in range(5):
+        assert eng2.store(0).get(f"/fast{i}", False, False).node.value \
+            == f"f{i}"
+        assert eng2.store(0).get(f"/fast{i}", False,
+                                 False).node.modified_index == idxs[i]
+    assert eng2.store(0).get("/seed", False, False).node.value == "s2"
+    eng2.wal.close()
