@@ -42,7 +42,7 @@ class ClusterFixture:
             tick_ms=self.tick_ms,
             snap_count=self.snap_count,
             catch_up_entries=self.catch_up,
-            request_timeout=5.0,
+            request_timeout=30.0,  # generous: CI boxes run single-core under load
         )
 
     def launch(self, name, cfg=None):

@@ -739,7 +739,7 @@ def test_v3_survives_snapshot_catchup(tmp_path):
             name=f"m{i}", data_dir=str(tmp_path / f"m{i}"),
             initial_cluster=peer_urls,
             listen_client_urls=[f"http://127.0.0.1:{ports[n + i]}"],
-            tick_ms=10, request_timeout=5.0,
+            tick_ms=10, request_timeout=20.0,
             snap_count=10, catch_up_entries=2))
 
     members = [mk(i) for i in range(n)]
@@ -751,7 +751,7 @@ def test_v3_survives_snapshot_catchup(tmp_path):
         st, _, body = req(
             "POST", members[member].client_urls[0] + "/v3/kv/put",
             json.dumps({"key": e(k), "value": e(v)}).encode(),
-            {"Content-Type": "application/json"})
+            {"Content-Type": "application/json"}, timeout=30.0)
         assert st == 200, body
         return body
 
@@ -761,7 +761,8 @@ def test_v3_survives_snapshot_catchup(tmp_path):
             body["range_end"] = e(end)
         st, _, r = req(
             "POST", members[member].client_urls[0] + "/v3/kv/range",
-            json.dumps(body).encode(), {"Content-Type": "application/json"})
+            json.dumps(body).encode(), {"Content-Type": "application/json"},
+            timeout=30.0)
         assert st == 200, r
         return r
 
@@ -773,7 +774,7 @@ def test_v3_survives_snapshot_catchup(tmp_path):
     # beyond m2's position.
     for i in range(5, 45):
         put(f"k{i:02d}", f"v{i}")
-    deadline = _t.time() + 15
+    deadline = _t.time() + 45      # single-core CI box under load
     while _t.time() < deadline:
         if all(m.server._snapi > 0 and
                m.server.raft_storage.first_index() > 6
