@@ -206,6 +206,7 @@ class HostEngine:
                                              bytes]] = {}
         self._snap_recs: List[Tuple[int, int, bytes]] = []
         self._snap_sent: Dict[Tuple[int, int], float] = {}
+        self._hist: Dict[Tuple[int, int], int] = {}
         self.snaps_sent = 0
         self.snaps_installed = 0
 
@@ -379,6 +380,22 @@ class HostEngine:
             log_term=self._global_col("log_term", base.log_term,
                                       self.l_ring),
         )
+        # Terms of committed-but-not-yet-applied entries that are (or may
+        # fall) below the device ring window: the live apply path resolves
+        # from here when the ring has moved on (see _apply_committed).
+        # Restore seeds it from the WAL's full ring-diff history; without
+        # it, a host restoring with applied < commit — an acked entry's
+        # payload lives on the ACKING host and must be pulled — jammed
+        # forever once the window passed the stalled span ("no term for
+        # committed entry", found by the stale-disk snapshot test).
+        # >= applied (not >): the no-op check for the NEXT entry needs the
+        # term of the last applied one (see _maybe_noop).
+        self._hist = {k: t for k, t in hist.items()
+                      if k[1] >= int(self.applied[k[0]])}
+        if ckpt is not None:
+            for g_s, i_s, t_s in ckpt.get("hist", []):
+                if int(i_s) >= int(self.applied[int(g_s)]):
+                    self._hist[(int(g_s), int(i_s))] = int(t_s)
         self.l_state = np.zeros(G, np.int32)
         self.l_lead = np.zeros(G, np.int32)
 
@@ -541,6 +558,8 @@ class HostEngine:
             # would otherwise occupy the pull budget forever.
             for k in [k for k in self._missing if k[0] == g and k[1] <= a]:
                 del self._missing[k]
+            for k in [k for k in self._hist if k[0] == g and k[1] < a]:
+                del self._hist[k]
             self._snap_recs.append((g, a, image))
             self.snaps_installed += 1
             touched = True
@@ -989,6 +1008,8 @@ class HostEngine:
                 t = 0
                 if i > self.l_last[g] - W:
                     t = int(self.l_ring[g, i % W])
+                if t == 0:
+                    t = self._hist.get((g, i), 0)
                 if t == 0 and hist is not None:
                     t = hist.get((g, i), 0)
                 if t == 0:
@@ -1008,6 +1029,28 @@ class HostEngine:
                         done = i
                         continue
                     self._missing.setdefault(key, now)
+                    # The stall can outlive the ring window (live traffic
+                    # keeps moving last_index): remember every term of the
+                    # committed span that is STILL resolvable now — plus
+                    # i-1's, which _maybe_noop(i) will need — so the retry
+                    # after the pull repairs the payload can never lose
+                    # them (the jam the stale-disk test found). In the
+                    # live path only the ring can resolve, so clamp the
+                    # rescan to the window instead of walking a possibly
+                    # huge backlog every stalled round.
+                    if hist is not None:
+                        start = max(i - 1, 1)
+                    else:
+                        start = max(i - 1, int(self.l_last[g]) - W + 1, 1)
+                    for j in range(start, hi + 1):
+                        if (g, j) not in self._hist:
+                            tj = 0
+                            if j > self.l_last[g] - W:
+                                tj = int(self.l_ring[g, j % W])
+                            if tj == 0 and hist is not None:
+                                tj = hist.get((g, j), 0)
+                            if tj:
+                                self._hist[(g, j)] = tj
                     break
                 if payload[0] == P_REQ:
                     r = Request.decode(payload[1:])
@@ -1060,16 +1103,29 @@ class HostEngine:
                             self.acked_requests += len(fp)
                 done = i
             self.applied[g] = done
+            if self._hist:
+                # Keep `done` itself: _maybe_noop(done + 1) reads its term.
+                for j in range(lo + 1, done):
+                    self._hist.pop((g, j), None)
 
     def _maybe_noop(self, g: int, i: int, t: int) -> bool:
         """True if entry (g, i, term t) is a leader no-op: it is the FIRST
         entry of term t in our log (leaders append exactly one payload-less
-        entry, at the start of their term — kernel _append_noop_and_lead)."""
+        entry, at the start of their term — kernel _append_noop_and_lead).
+        The previous entry's term resolves from the ring, falling back to
+        the retained-history map when it dropped below the window — a
+        term-boundary no-op below the window otherwise reads as a missing
+        payload and jams the apply cursor with unanswerable pulls (found
+        by the stale-disk snapshot test)."""
         W = self.cfg.window
-        if i - 1 >= 1 and i - 1 > self.l_last[g] - W:
+        if i == 1:
+            return True
+        prev_t = 0
+        if i - 1 > self.l_last[g] - W:
             prev_t = int(self.l_ring[g, (i - 1) % W])
-            return prev_t != 0 and prev_t < t
-        return i == 1
+        if prev_t == 0:
+            prev_t = self._hist.get((g, i - 1), 0)
+        return prev_t != 0 and prev_t < t
 
     def _apply_request(self, g: int, r: Request):
         st = self.store(g)
@@ -1140,6 +1196,14 @@ class HostEngine:
                 (g, i, t, _b64.b64encode(p).decode())
                 for (g, i, t), p in self.payloads.items()
                 if i > self.applied[g]],
+            # Terms of committed-but-unapplied entries below the ring
+            # window (see _hist): recs before this checkpoint get purged,
+            # taking their ring diffs with them, so a stalled span's terms
+            # must ride the checkpoint itself. >= applied, not >: the
+            # no-op check for entry applied+1 reads applied's term, and
+            # after the purge the checkpoint is its only source.
+            "hist": [(g, i, t) for (g, i), t in self._hist.items()
+                     if i >= self.applied[g]],
         }
         self.wal.save_checkpoint(self.round_no - 1, state)
 
@@ -1164,6 +1228,12 @@ class HostEngine:
         cutoff = time.time() - 60.0
         for k in [k for k, t0 in self._snap_sent.items() if t0 < cutoff]:
             del self._snap_sent[k]
+        # Stale retained-term entries: the per-pass prune keeps each
+        # pass's boundary entries, which fall below `applied` once later
+        # passes move on — sweep them here (checkpoint cadence).
+        for k in [k for k in self._hist
+                  if k[1] < self.applied[k[0]]]:
+            del self._hist[k]
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def run_rank() -> int:
+    import logging
+    logging.basicConfig(
+        level=os.environ.get("MHE_LOG", "INFO").upper(),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
     rank = int(os.environ["MHE_RANK"])
     n = int(os.environ["MHE_NHOSTS"])
     coord = os.environ["MHE_COORD"]

@@ -278,3 +278,81 @@ def test_host_loss_with_disk_loss_recovers_via_snapshots(tmp_path):
                     os.kill(pid, signal.SIGKILL)
                 except OSError:
                     pass
+
+
+def test_unpack_snaps_truncation_rejected():
+    """A snap frame whose image length exceeds the remaining bytes must
+    raise at drain time (inside the per-frame try), never hand a silently
+    truncated store image to the install path."""
+    import numpy as np
+    from etcd_tpu.server.hostengine import _pack_snaps, _unpack_snaps
+    row = np.arange(8, dtype=np.int32)
+    blob = _pack_snaps([(3, 9, 2, 1, row, b"STORE-IMAGE-BYTES")])
+    out = _unpack_snaps(blob, 8)
+    assert out[0][:4] == (3, 9, 2, 1)
+    assert (out[0][4] == row).all() and out[0][5] == b"STORE-IMAGE-BYTES"
+    with pytest.raises(ValueError, match="truncated"):
+        _unpack_snaps(blob[:-4], 8)
+
+
+@pytest.mark.slow
+def test_stale_disk_restart_catches_up_via_snapshots(tmp_path):
+    """A host restarting from a STALE (not empty) disk — lost segments,
+    restored backup — lags beyond the ring window and must converge via
+    cross-host snapshot install OVER its existing store state, with no
+    supervisor or term floor involved (its vote records are intact)."""
+    import shutil as _sh
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_hostengine import Cluster, _get, _put
+    W = 8
+    cl = Cluster(tmp_path, n=3, groups=2,
+                 extra_env={"MHE_WINDOW": str(W)}).start()
+    try:
+        cl.wait_up()
+        # Phase 1: a little data, then snapshot host2's dir (the "backup").
+        for g in range(2):
+            for i in range(3):
+                _put(cl.base(g % 3), g, f"s{i}", f"old{g}{i}")
+        time.sleep(1.0)       # let host2 fsync its rounds
+        cl.kill_all()
+        backup = str(tmp_path / "host2.backup")
+        _sh.copytree(os.path.join(cl.data, "host2"), backup)
+
+        # Phase 2: restart, write far past the ring window, kill again.
+        cl.start()
+        cl.wait_up()
+        for g in range(2):
+            for i in range(W + 6):
+                _put(cl.base((g + i) % 3), g, f"k{i}", f"new{g}{i}")
+        cl.kill_all()
+
+        # Phase 3: host2 comes back from the STALE backup.
+        _sh.rmtree(os.path.join(cl.data, "host2"))
+        _sh.copytree(backup, os.path.join(cl.data, "host2"))
+        cl.start()
+        cl.wait_up()
+        deadline = time.time() + 90
+        sv = None
+        while time.time() < deadline:
+            try:
+                sv = cl.status(2)
+                s0 = cl.status(0)
+                if (sv.get("snaps_installed", 0) >= 1
+                        and sv["applied_total"]
+                        >= s0["applied_total"] - 2):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        else:
+            cl.dump_logs()
+            raise AssertionError(f"stale host never caught up: {sv}")
+        # Every write acked in phase 2 is readable from host2's OWN store.
+        for g in range(2):
+            for i in range(W + 6):
+                got = _get(cl.base(2), g, f"k{i}")
+                assert got["node"]["value"] == f"new{g}{i}", (g, i, got)
+            got = _get(cl.base(2), g, "s0")
+            assert got["node"]["value"] == f"old{g}0"
+    finally:
+        cl.kill_all()
