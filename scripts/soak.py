@@ -153,11 +153,17 @@ def soak_hostengine(n_seeds: int, meta_seed: int = 0) -> None:
         n_hosts = int(rng.choice([2, 3]))
         groups = int(rng.choice([4, 6]))
         drop = float(rng.choice([0, 30, 60]))
+        # Small windows push restart catch-up past the device ring, so
+        # kill/restart cycles exercise the cross-host snapshot install +
+        # retained-term machinery, not just pulls (the W=8 stale-disk jam
+        # was invisible at the default 32).
+        window = int(rng.choice([8, 16, 32]))
         acked = {}
         with tempfile.TemporaryDirectory() as d:
             cl = Cluster(d, n=n_hosts, groups=groups,
                          extra_env={"MHE_DROP_PAY_PCT": str(drop),
                                     "MHE_FAULT_SEED": str(seed),
+                                    "MHE_WINDOW": str(window),
                                     "MHE_REQ_TIMEOUT": "30"}).start()
             try:
                 cl.wait_up()
@@ -208,7 +214,7 @@ def soak_hostengine(n_seeds: int, meta_seed: int = 0) -> None:
             finally:
                 cl.kill_all()
         print(f"hostengine seed {seed}: {n_hosts} hosts, drop={drop}%, "
-              f"{len(acked)} acked, zero lost", flush=True)
+              f"W={window}, {len(acked)} acked, zero lost", flush=True)
     print(f"hostengine soak OK: {n_seeds} campaigns, zero acked writes "
           f"lost")
 
