@@ -71,11 +71,15 @@ def soak_engine(n_seeds: int, meta_seed: int = 0) -> None:
     for k in range(n_seeds):
         seed = int(meta.randint(1, 1 << 30))
         rng = np.random.RandomState(seed)
+        # Small windows push partition/restart recovery onto the
+        # snapshot-install path (_service_need_host) instead of plain
+        # appends; fixed per seed (geometry is persisted per data dir).
+        window = int(rng.choice([8, 16]))
         acked = {}
         with tempfile.TemporaryDirectory() as d:
             def mk():
                 return MultiEngine(EngineConfig(
-                    groups=4, peers=5, window=16, max_ents=4,
+                    groups=4, peers=5, window=window, max_ents=4,
                     heartbeat_tick=3, data_dir=d, fsync=False,
                     request_timeout=60.0, initial_peers=3))
 
