@@ -58,6 +58,7 @@ def run_rank() -> int:
         frame_peers={h: ("127.0.0.1", frame_ports[h]) for h in range(n)},
         window=int(os.environ.get("MHE_WINDOW", "32")),
         max_ents=int(os.environ.get("MHE_MAX_ENTS", "8")),
+        checkpoint_rounds=int(os.environ.get("MHE_CKPT_ROUNDS", "4096")),
         fsync=os.environ.get("MHE_FSYNC", "1") == "1",
         request_timeout=float(os.environ.get("MHE_REQ_TIMEOUT", "20")),
         round_interval=float(os.environ.get("MHE_ROUND_INTERVAL", "0")),

@@ -305,8 +305,13 @@ def test_stale_disk_restart_catches_up_via_snapshots(tmp_path):
     sys.path.insert(0, os.path.join(REPO, "tests"))
     from test_hostengine import Cluster, _get, _put
     W = 8
+    # Frequent checkpoints: a checkpoint can land while a host's apply
+    # cursor is STALLED below the ring window (segments before it get
+    # purged), so the retained-term map must survive through the
+    # checkpoint itself — the fourth restart below restores from one.
     cl = Cluster(tmp_path, n=3, groups=2,
-                 extra_env={"MHE_WINDOW": str(W)}).start()
+                 extra_env={"MHE_WINDOW": str(W),
+                            "MHE_CKPT_ROUNDS": "40"}).start()
     try:
         cl.wait_up()
         # Phase 1: a little data, then snapshot host2's dir (the "backup").
@@ -352,6 +357,18 @@ def test_stale_disk_restart_catches_up_via_snapshots(tmp_path):
             for i in range(W + 6):
                 got = _get(cl.base(2), g, f"k{i}")
                 assert got["node"]["value"] == f"new{g}{i}", (g, i, got)
+            got = _get(cl.base(2), g, "s0")
+            assert got["node"]["value"] == f"old{g}0"
+
+        # Phase 4: one more whole-job bounce — every host now restores
+        # from a checkpoint written during/after the catch-up (including
+        # rec.snaps/hist roundtrips) and must still serve everything.
+        cl.kill_all()
+        cl.start()
+        cl.wait_up()
+        for g in range(2):
+            got = _get(cl.base(2), g, f"k{W + 5}")
+            assert got["node"]["value"] == f"new{g}{W + 5}", (g, got)
             got = _get(cl.base(2), g, "s0")
             assert got["node"]["value"] == f"old{g}0"
     finally:
