@@ -233,19 +233,23 @@ def main() -> int:
               file=sys.stderr)
         return 2
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    # Optional third arg: meta seed. Without it every invocation replays
+    # the SAME campaign seeds — good for reproduction, useless for
+    # accumulating chaos mileage across runs.
+    ms = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     if what == "kernel":
-        soak_kernel(n or 200)
+        soak_kernel(n or 200, meta_seed=ms)
     elif what == "engine":
-        soak_engine(n or 3)
+        soak_engine(n or 3, meta_seed=ms)
     elif what == "hostengine":
-        soak_hostengine(n or 2)
+        soak_hostengine(n or 2, meta_seed=ms)
     else:
         # 'all' keeps per-soak defaults: an explicit count meant for the
         # ~0.3s kernel schedules must not launch that many multi-minute
         # engine campaigns.
-        soak_kernel(n or 200)
-        soak_engine(3)
-        soak_hostengine(2)
+        soak_kernel(n or 200, meta_seed=ms)
+        soak_engine(3, meta_seed=ms)
+        soak_hostengine(2, meta_seed=ms)
     return 0
 
 
