@@ -18,21 +18,51 @@ from etcd_tpu.embed import Etcd, EtcdConfig
 from tests.test_http import FORM_HDR, form, free_ports, req
 
 
-@pytest.fixture(scope="module")
-def member(tmp_path_factory):
-    """A single-member cluster, like the reference's NewCluster(t, 1)."""
+@pytest.fixture(scope="module",
+                params=["member", "tenant"])
+def member(tmp_path_factory, request):
+    """The same conformance tables run against BOTH serving surfaces:
+    a classic single-member cluster (the reference's NewCluster(t, 1))
+    and one tenant keyspace of the batched multi-tenant engine at
+    /tenants/{g} — the engine's v2 surface must be semantically
+    indistinguishable from the reference member's."""
     tmp = tmp_path_factory.mktemp("v2matrix")
-    pp, cp = free_ports(2)
-    cfg = EtcdConfig(
-        name="m0", data_dir=str(tmp / "m0"),
-        initial_cluster={"m0": [f"http://127.0.0.1:{pp}"]},
-        listen_client_urls=[f"http://127.0.0.1:{cp}"],
-        tick_ms=10, request_timeout=5.0)
-    m = Etcd(cfg)
-    m.start()
-    assert m.wait_leader(10)
-    yield m
-    m.stop()
+    if request.param == "member":
+        pp, cp = free_ports(2)
+        cfg = EtcdConfig(
+            name="m0", data_dir=str(tmp / "m0"),
+            initial_cluster={"m0": [f"http://127.0.0.1:{pp}"]},
+            listen_client_urls=[f"http://127.0.0.1:{cp}"],
+            tick_ms=10, request_timeout=5.0)
+        m = Etcd(cfg)
+        m.start()
+        assert m.wait_leader(10)
+        yield m
+        m.stop()
+        return
+    from types import SimpleNamespace
+
+    from etcd_tpu.etcdhttp.tenants import EngineHttp
+    from etcd_tpu.server.engine import EngineConfig, MultiEngine
+
+    (cp,) = free_ports(1)
+    eng = MultiEngine(EngineConfig(
+        groups=4, peers=3, data_dir=str(tmp / "eng"), window=16,
+        max_ents=4, heartbeat_tick=3, fsync=False, request_timeout=15.0,
+        round_interval=0.0005))
+    http = EngineHttp(eng, port=cp)
+    eng.start()
+    http.start()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if all(eng.leader_slot(g) >= 0 for g in range(4)):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("engine elections failed")
+    yield SimpleNamespace(client_urls=[http.url + "/tenants/2"])
+    http.stop()
+    eng.stop()
 
 
 def curl(member, method, path, data=None):
