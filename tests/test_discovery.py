@@ -132,3 +132,110 @@ def test_srv_cluster_fake_resolver():
 def test_srv_cluster_no_records():
     with pytest.raises(RuntimeError):
         srv_cluster("example.com", "x", [], lookup=lambda *a: [])
+
+
+@pytest.mark.slow
+def test_discovery_hosted_on_a_tenant_keyspace(tmp_path):
+    """The engine is its own discovery service: seed the registry size
+    key in a TENANT keyspace, then bootstrap a classic 3-member cluster
+    with --discovery pointed at the tenant URL (the reference's
+    discovery.etcd.io is itself just an etcd; here one tenant of the
+    batched engine plays that role). Subprocess members exercise the
+    full etcdmain discovery path against the tenant surface."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import time
+    import urllib.error
+    import urllib.request
+
+    from etcd_tpu.etcdhttp.tenants import EngineHttp
+    from etcd_tpu.server.engine import EngineConfig, MultiEngine
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def put(url, body):
+        req = urllib.request.Request(
+            url, body, {"Content-Type": "application/x-www-form-urlencoded"},
+            method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=20) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+
+    (ep,) = free_ports(1)
+    eng = MultiEngine(EngineConfig(
+        groups=2, peers=3, data_dir=str(tmp_path / "eng"), window=16,
+        max_ents=4, heartbeat_tick=3, fsync=False, request_timeout=15.0,
+        round_interval=0.0005))
+    http = EngineHttp(eng, port=ep)
+    eng.start()
+    http.start()
+    procs = []
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline and not all(
+                eng.leader_slot(g) >= 0 for g in range(2)):
+            time.sleep(0.05)
+        disc = f"{http.url}/tenants/1/v2/keys/_etcd/registry/tok1"
+        assert put(f"{disc}/_config/size", b"value=3") in (200, 201)
+
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        ports = [free_ports(2) for _ in range(3)]
+        for i, (pp, cp) in enumerate(ports):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "etcd_tpu", "--name", f"m{i}",
+                 "--data-dir", str(tmp_path / f"m{i}"),
+                 "--listen-peer-urls", f"http://127.0.0.1:{pp}",
+                 "--initial-advertise-peer-urls", f"http://127.0.0.1:{pp}",
+                 "--listen-client-urls", f"http://127.0.0.1:{cp}",
+                 "--advertise-client-urls", f"http://127.0.0.1:{cp}",
+                 "--discovery", disc,
+                 "--heartbeat-interval", "20",
+                 "--election-timeout", "200"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        deadline = time.time() + 150
+        n = 0
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{ports[0][1]}/v2/members",
+                        timeout=3) as r:
+                    n = len(json.loads(r.read())["members"])
+                if n == 3:
+                    break
+            except Exception:  # noqa: BLE001 — members still booting
+                pass
+            time.sleep(1)
+        assert n == 3, f"cluster formed with {n} members"
+        # 301 during election windows: retry like a real client.
+        ok = False
+        for _ in range(30):
+            if put(f"http://127.0.0.1:{ports[1][1]}/v2/keys/bootok",
+                   b"value=1") in (200, 201):
+                ok = True
+                break
+            time.sleep(1)
+        assert ok, "bootstrapped cluster never served a write"
+        # The registry in the tenant recorded all three members.
+        with urllib.request.urlopen(f"{disc}?recursive=true",
+                                    timeout=10) as r:
+            reg = json.loads(r.read())
+        slots = [nd for nd in reg["node"].get("nodes", [])
+                 if not nd["key"].endswith("_config")]
+        assert len(slots) == 3, reg
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        http.stop()
+        eng.stop()
