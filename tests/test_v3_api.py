@@ -747,24 +747,30 @@ def test_v3_survives_snapshot_catchup(tmp_path):
         m.start()
     assert all(m.wait_leader(10) for m in members)
 
+    def _v3(member, route, body):
+        """One rpc with client-style retries: a restarting member's
+        election timer can briefly disrupt leadership (this test restarts
+        m2 on purpose), and real etcd clients retry the resulting
+        timeout/no-leader errors — so does this driver."""
+        payload = json.dumps(body).encode()
+        deadline = _t.time() + 60
+        while True:
+            st, _, r = req(
+                "POST", members[member].client_urls[0] + route, payload,
+                {"Content-Type": "application/json"}, timeout=30.0)
+            if st == 200 or _t.time() > deadline:
+                assert st == 200, r
+                return r
+            _t.sleep(0.5)
+
     def put(k, v, member=0):
-        st, _, body = req(
-            "POST", members[member].client_urls[0] + "/v3/kv/put",
-            json.dumps({"key": e(k), "value": e(v)}).encode(),
-            {"Content-Type": "application/json"}, timeout=30.0)
-        assert st == 200, body
-        return body
+        return _v3(member, "/v3/kv/put", {"key": e(k), "value": e(v)})
 
     def rng(member, k="a", end=None):
         body = {"key": e(k)}
         if end:
             body["range_end"] = e(end)
-        st, _, r = req(
-            "POST", members[member].client_urls[0] + "/v3/kv/range",
-            json.dumps(body).encode(), {"Content-Type": "application/json"},
-            timeout=30.0)
-        assert st == 200, r
-        return r
+        return _v3(member, "/v3/kv/range", body)
 
     for i in range(5):
         put(f"k{i:02d}", f"v{i}")
