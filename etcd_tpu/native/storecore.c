@@ -828,7 +828,15 @@ Core_set(CoreObject *c, PyObject *args)
  * -length lists of str, no TTL, no dirs. Per-op etcd errors (e.g. set
  * over a dir) fail THAT op exactly as the scalar call would — stats
  * counted, index unmoved — and the batch continues; only fatal errors
- * (OOM) abort. History ring records are produced per applied op, so
+ * (OOM, a non-str item) abort. CONTRACT on a fatal abort: ops before the
+ * failing one HAVE been applied and current_index HAS advanced, and the
+ * exception does not say how far — so the caller must treat the
+ * exception as fatal to the apply loop and HALT (the engine applier
+ * fail-stops and re-raises, server/engine.py _applier_loop; recovery is
+ * WAL replay, which re-applies the span deterministically). Continuing
+ * past it would diverge replicas on a nondeterministic failure (e.g.
+ * OOM on one member only), where the scalar path fails one request
+ * atomically. History ring records are produced per applied op, so
  * watch scans and the facade's not-quiet re-notify see every event.
  * Returns (first_index, last_index, n_failed, recs) — recs is a list of
  * per-applied-op (nd, pd|None, index) when want_recs is true (so a

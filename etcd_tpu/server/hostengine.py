@@ -267,14 +267,19 @@ class HostEngine:
     def _load_term_floor(self) -> Optional[np.ndarray]:
         """Per-group term floor written by the degraded-restart supervisor
         into an EMPTY data dir (this host's disk was lost with the host):
-        the elementwise max of every survivor's recorded terms. Booting at
-        the floor fences the lost vote records — any vote the dead
-        incarnation cast in a term above all survivors' terms can only
-        have been a self-vote (a candidate persists its own term wherever
-        it campaigns), which can never complete a quorum now that the
-        incarnation is gone; all fresh votes happen at floor+1 and above.
-        Ignored once a checkpoint exists (the checkpoint carries full
-        term state recorded while the floor was in effect)."""
+        the elementwise max of every survivor's recorded terms, PLUS ONE.
+        Booting at the floor with a clear vote fences the lost vote
+        records: the earliest term this host can now grant at is the
+        floor, and no pre-crash election can have completed at any term
+        >= floor — completion needs a durable grant on a survivor (round
+        records fsync term+log diffs atomically), and all survivors'
+        durable terms are <= floor-1. The +1 (vs the elementwise max)
+        closes the boundary race where one survivor durably recorded an
+        election won at exactly max(survivor terms) with the dead host's
+        lost grant while a lagging survivor still reads one term lower
+        and would re-campaign at that same term. Ignored once a
+        checkpoint exists (the checkpoint carries full term state
+        recorded while the floor was in effect)."""
         import os
         path = os.path.join(self.cfg.data_dir, "term_floor.json")
         if not os.path.exists(path):

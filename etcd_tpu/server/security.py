@@ -36,14 +36,30 @@ STORE_PERMS_PREFIX = "/2"       # reference security.go:21
 ROOT_ROLE = "root"
 GUEST_ROLE = "guest"
 
-_PBKDF2_ITERS = 4096            # bcrypt-cost stand-in; tagged in the hash
+# pbkdf2 is the bcrypt stand-in (no bcrypt in the image); the iteration
+# count is tagged into each stored hash so existing hashes keep verifying
+# when the default changes. 600k matches current OWASP guidance for
+# pbkdf2-sha256; tests override via ETCD_PBKDF2_ITERS to stay fast.
+_PBKDF2_ITERS = int(os.environ.get("ETCD_PBKDF2_ITERS", "600000"))
 
 
-def hash_password(password: str, iters: int = _PBKDF2_ITERS) -> str:
+def hash_password(password: str, iters: Optional[int] = None) -> str:
+    if iters is None:
+        iters = _PBKDF2_ITERS
     salt = os.urandom(16).hex()
     h = hashlib.pbkdf2_hmac("sha256", password.encode(), salt.encode(),
                             iters).hex()
     return f"pbkdf2${iters}${salt}${h}"
+
+
+# Verification cache: basic-auth re-verifies on EVERY request (the
+# reference runs bcrypt per request too, security.go usersEqual), and at
+# 600k iterations an uncached check is hundreds of ms of CPU per request
+# on a small host. Key = digest of (stored-hash, password) so plaintext
+# never sits in memory; the cached bit is exactly the deterministic
+# function result. Bounded; cleared wholesale when full.
+_VERIFY_CACHE: dict = {}
+_VERIFY_CACHE_MAX = 1024
 
 
 def check_password(stored: str, password: str) -> bool:
@@ -51,9 +67,17 @@ def check_password(stored: str, password: str) -> bool:
         tag, iters, salt, want = stored.split("$")
         if tag != "pbkdf2":
             return False
+        ck = hashlib.sha256(f"{stored}\x00{password}".encode()).digest()
+        hit = _VERIFY_CACHE.get(ck)
+        if hit is not None:
+            return hit
         got = hashlib.pbkdf2_hmac("sha256", password.encode(), salt.encode(),
                                   int(iters)).hex()
-        return hmac.compare_digest(got, want)
+        ok = hmac.compare_digest(got, want)
+        if len(_VERIFY_CACHE) >= _VERIFY_CACHE_MAX:
+            _VERIFY_CACHE.clear()
+        _VERIFY_CACHE[ck] = ok
+        return ok
     except (ValueError, AttributeError):
         return False
 

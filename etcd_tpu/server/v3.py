@@ -9,11 +9,12 @@ recorded transactionally alongside each apply so WAL replay after a crash
 never double-applies (double-apply would fork the revision sequence between
 members — the exact bug etcd v3's consistentIndex exists to prevent).
 
-Known preview limitation (documented in docs/divergences.md): the v2
-snapshot does not carry the v3 keyspace, so a follower that catches up via
-snapshot-install resumes v3 ops only from its own consistent index. The
-reference has no v3 serving at all, so there is no behavior to diverge
-from; single-member restarts and normal WAL catch-up are fully covered.
+Snapshot catch-up: the member snapshot is a COMPOSITE of the v2 store and
+the v3 image (server.py:66-75), so a follower that falls behind the
+compacted log receives the v3 keyspace with the install and resumes from
+the snapshot's consistent index (tests/test_v3_api.py
+test_v3_survives_snapshot_catchup). The reference has no v3 serving at
+all, so there is no behavior to diverge from.
 
 Op / response shapes follow the RFC proto messages with the etcd JSON
 gateway convention: `key`/`value`/`range_end` are base64 strings.

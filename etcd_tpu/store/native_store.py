@@ -229,12 +229,15 @@ class NativeStore:
         O(n), and immune to a batch larger than the history ring evicting
         its own earliest records. A watcher that registers in the window
         between the check and the GIL-atomic C call is caught by the
-        post-check and notified from the ring, clamped to what the ring
-        still holds — an event evicted by the same oversized batch is the
-        'fell behind the 1000-event history' case, which the next
-        waitIndex scan answers with 401 EventIndexCleared exactly like
-        the reference (store/event_history.go). Returns the number
-        applied."""
+        post-check and notified from the ring; if that same oversized
+        batch already evicted part of its own span from the ring, a live
+        stream watcher could otherwise miss the evicted events with no
+        signal (the reference notifies per-op, so a registered watcher
+        never misses; its 401 EventIndexCleared only covers NEW waitIndex
+        registrations, store/event_history.go) — so in that corner the
+        hub is cleared: every raced watcher wakes with the
+        WATCHER_CLEARED sentinel and re-registers, and a stale waitIndex
+        then gets the honest 401. Returns the number applied."""
         now = self.clock()
         hub = self.watcher_hub
         want_recs = not hub.quiet()
@@ -253,6 +256,15 @@ class NativeStore:
             # Registration raced the atomic batch; replay what the ring
             # still holds (single pass over the clamped span).
             lo = max(first, self._core.ring_bounds()[0])
+            if lo > first:
+                # The batch evicted part of its own span: a stream
+                # watcher that registered mid-batch would silently skip
+                # the evicted events. Resync instead of lying: wake every
+                # watcher with the cleared sentinel (store Recovery
+                # semantics); re-registration with a stale waitIndex gets
+                # 401 EventIndexCleared from the next scan.
+                hub.clear()
+                return len(paths) - failed
             scan = hub.event_history.scan
             for i in range(lo, last + 1):
                 e = scan("/", True, i)

@@ -81,14 +81,25 @@ class Supervisor:
     def prepare_dirs(self) -> None:
         """Degraded restart: if some rank's data dir vanished with its
         machine while survivors still hold WAL data, write a per-group
-        TERM FLOOR (elementwise max of every survivor's recorded terms)
-        into a fresh dir for it. The respawned rank then boots empty-but-
-        fenced: any vote its dead incarnation cast in a term above the
-        floor can only have been a self-vote, which can never complete a
-        quorum now that the incarnation is gone — so granting fresh votes
-        from floor+1 up is safe under single host failure. The empty rank
-        rejoins as a follower and catches up through the engines'
-        cross-host snapshot-install path (hostengine._send_snapshots)."""
+        TERM FLOOR of (elementwise max of every survivor's recorded
+        terms) + 1 into a fresh dir for it. The respawned rank boots at
+        the floor with a clear vote, so the EARLIEST term at which it can
+        grant a vote is the floor itself. No pre-crash election can have
+        COMPLETED at any term >= floor: completing a quorum in an N=3
+        mesh needs a durable grant on at least one survivor (per-host
+        round records fsync term and log diffs atomically), and every
+        survivor's durable term is <= floor-1 by construction. A vote
+        the dead incarnation cast at >= floor can only have been its own
+        self-vote, which can never complete a quorum now that the
+        incarnation is gone. The +1 closes the boundary race where one
+        survivor durably recorded an election at exactly max(survivor
+        terms) — won pre-crash with the dead host's now-lost grant —
+        while a lagging survivor (unsynchronized per-round fsyncs) still
+        reads one term lower, re-campaigns at exactly that term, and the
+        empty host's grant would seat a second leader at the same term.
+        The empty rank rejoins as a follower and catches up through the
+        engines' cross-host snapshot-install path
+        (hostengine._send_snapshots)."""
         dirs = [os.path.join(self.data, f"host{r}") for r in range(self.n)]
 
         def has_data(d):
@@ -107,6 +118,10 @@ class Supervisor:
             if h:
                 t = load_terms(d, self.groups)
                 floor = t if floor is None else np.maximum(floor, t)
+        # +1: fence the boundary term (see docstring) — the rebooted empty
+        # host must not be able to grant at a term where a pre-crash
+        # election may have completed.
+        floor = floor + 1
         for r, (d, h) in enumerate(zip(dirs, has)):
             if h:
                 continue

@@ -9,6 +9,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+# Keep the bcrypt-stand-in cheap under test (production default is 600k;
+# the count is tagged into each hash, so both verify correctly).
+os.environ.setdefault("ETCD_PBKDF2_ITERS", "4096")
 
 from etcd_tpu.utils.platform import enable_compile_cache, force_cpu  # noqa: E402
 

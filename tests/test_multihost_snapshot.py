@@ -86,7 +86,12 @@ def test_load_terms_checkpoint_plus_replay(tmp_path):
 
 def test_supervisor_prepare_dirs_writes_floor(tmp_path):
     """Two survivor dirs with different terms -> the missing rank's fresh
-    dir gets the elementwise max as its term floor."""
+    dir gets (elementwise max) + 1 as its term floor. The +1 is the
+    boundary fence: the rebooted empty host grants votes no earlier than
+    the floor, and an election could only have completed pre-crash at a
+    term durably recorded by some survivor (<= floor-1) — so a lagging
+    survivor re-campaigning at exactly max(survivor terms) can no longer
+    collect the empty host's grant and seat a second same-term leader."""
     sys.path.insert(0, os.path.join(REPO, "scripts"))
     from etcd_tpu.server.enginewal import EngineWAL, RoundRecord
     import importlib
@@ -107,7 +112,7 @@ def test_supervisor_prepare_dirs_writes_floor(tmp_path):
     sup.prepare_dirs()
     with open(os.path.join(data, "host2", "term_floor.json")) as f:
         floor = json.load(f)["term"]
-    assert floor == [5, 9]
+    assert floor == [6, 10]
     # Survivors' dirs are untouched.
     assert not os.path.exists(os.path.join(data, "host0",
                                            "term_floor.json"))
