@@ -795,6 +795,84 @@ def _run_child(extra_env: dict, timeout_s: float):
     return best
 
 
+def _regression_gate(line: str) -> None:
+    """Diff the final result against the previous round's driver artifact
+    (BENCH_r{N}.json) and flag >20% same-workload drops LOUDLY — the r04
+    artifact shipped a churn number measured at a silently redefined
+    geometry (P=7 vs r03's P=5) plus a contention-skewed uniform number,
+    and nothing called it out. Comparisons are gated on matching platform
+    AND matching geometry (metric name carries groups/peers; churn
+    carries its own 'peers'; engine its own 'groups') so a legitimate
+    workload change reads as 'not comparable', never as a regression.
+    On a flagged drop the LAST emitted line carries 'perf_regressions',
+    so the marker lands in the artifact of record."""
+    import glob as _g
+    import re as _re
+    try:
+        cur = json.loads(line)
+    except ValueError:
+        return
+    root = os.path.dirname(os.path.abspath(__file__))
+    arts = sorted(_g.glob(os.path.join(root, "BENCH_r*.json")),
+                  key=lambda p: int(_re.search(r"r(\d+)", p).group(1)))
+    prev = None
+    for p in reversed(arts):
+        try:
+            with open(p) as f:
+                cand = json.load(f).get("parsed")
+            if cand and cand.get("value"):
+                prev, prev_name = cand, os.path.basename(p)
+                break
+        except (ValueError, OSError):
+            continue
+    if prev is None:
+        return
+    flags = []
+
+    def cmp(name, new, old, new_geom, old_geom):
+        if not new or not old:
+            return
+        if new_geom != old_geom:
+            log(f"perf-gate: {name} not comparable to {prev_name} "
+                f"({new_geom} vs {old_geom})")
+            return
+        if new < 0.8 * old:
+            flags.append({"scenario": name, "now": new, "prev": old,
+                          "prev_artifact": prev_name,
+                          "drop_pct": round(100 * (1 - new / old), 1)})
+
+    plat = cur.get("platform")
+    prev_plat = prev.get("platform")
+    # The primary's metric string doesn't encode WHICH scenario led the
+    # run (a BENCH_SCENARIO=engine run reuses it) — gate on the scenario
+    # name too, or a subset run gets compared against uniform.
+    cmp("primary", cur.get("value"), prev.get("value"),
+        (cur.get("metric"), cur.get("scenario"), plat),
+        (prev.get("metric"), prev.get("scenario"), prev_plat))
+    for sc, v in (cur.get("scenarios") or {}).items():
+        o = (prev.get("scenarios") or {}).get(sc)
+        if not o:
+            continue
+        geom_keys = {"churn": "peers", "engine": "groups"}.get(sc)
+        # Older artifacts (r03 and before) carry no per-scenario
+        # platform key — fall back to the artifact-level platform on
+        # BOTH sides, or every scenario reads "not comparable" and the
+        # gate silently no-ops.
+        ng = (v.get(geom_keys) if geom_keys else None,
+              v.get("platform", plat))
+        og = (o.get(geom_keys) if geom_keys else None,
+              o.get("platform", prev_plat))
+        cmp(sc, v.get("commits_per_sec"), o.get("commits_per_sec"),
+            ng, og)
+    if flags:
+        for fl in flags:
+            log(f"PERF REGRESSION vs {fl['prev_artifact']}: "
+                f"{fl['scenario']} {fl['now']:,} vs {fl['prev']:,} "
+                f"(-{fl['drop_pct']}%)")
+        cur["perf_regressions"] = flags
+        print(json.dumps(cur), flush=True)
+
+
 def main() -> int:
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
@@ -867,6 +945,11 @@ def main() -> int:
             "vs_baseline": 0.0,
             "error": "benchmark children timed out (backend init hang?)",
         }), flush=True)
+    else:
+        try:
+            _regression_gate(line)
+        except Exception as e:  # noqa: BLE001 — the gate must never
+            log(f"perf-gate skipped: {e}")   # invalidate a measurement
     return 0
 
 

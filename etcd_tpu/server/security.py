@@ -55,11 +55,15 @@ def hash_password(password: str, iters: Optional[int] = None) -> str:
 # Verification cache: basic-auth re-verifies on EVERY request (the
 # reference runs bcrypt per request too, security.go usersEqual), and at
 # 600k iterations an uncached check is hundreds of ms of CPU per request
-# on a small host. Key = digest of (stored-hash, password) so plaintext
-# never sits in memory; the cached bit is exactly the deterministic
-# function result. Bounded; cleared wholesale when full.
+# on a small host. The cache key is itself a SMALL pbkdf2 of
+# (stored-hash, password) — ~1k iterations, ~1 ms — NOT a bare sha256:
+# a process-memory disclosure of the key must not hand an attacker a
+# GPU-speed fingerprint of an in-use password (bare sha256 would undo
+# the 600k-iteration hardening by ~10^6x for recently-auth'd accounts).
+# Bounded; cleared wholesale when full.
 _VERIFY_CACHE: dict = {}
 _VERIFY_CACHE_MAX = 1024
+_CACHE_KEY_ITERS = 1000
 
 
 def check_password(stored: str, password: str) -> bool:
@@ -67,7 +71,9 @@ def check_password(stored: str, password: str) -> bool:
         tag, iters, salt, want = stored.split("$")
         if tag != "pbkdf2":
             return False
-        ck = hashlib.sha256(f"{stored}\x00{password}".encode()).digest()
+        ck = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                                 f"cache\x00{stored}".encode(),
+                                 _CACHE_KEY_ITERS)
         hit = _VERIFY_CACHE.get(ck)
         if hit is not None:
             return hit

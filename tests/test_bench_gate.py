@@ -1,0 +1,99 @@
+"""The bench perf-regression gate (bench.py _regression_gate): a >20%
+same-platform, same-geometry drop vs the newest BENCH_r*.json artifact
+must be flagged loudly in the emitted line; a geometry or platform change
+must read as not-comparable, never as a regression (the r04 lesson: churn
+moved to P=7 and the −63% 'regression' was a silently redefined
+workload)."""
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_gate_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _newest_artifact():
+    import glob
+    import re
+    arts = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                  key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+    for p in reversed(arts):
+        with open(p) as f:
+            parsed = json.load(f).get("parsed")
+        if parsed and parsed.get("value"):
+            return parsed
+    return None
+
+
+def test_gate_flags_big_drop(capsys):
+    prev = _newest_artifact()
+    if prev is None:
+        import pytest
+        pytest.skip("no driver artifact in tree")
+    bench = _load_bench()
+    cur = {"metric": prev["metric"], "value": 1.0,
+           "scenario": prev.get("scenario"),
+           "platform": prev.get("platform"), "scenarios": {}}
+    bench._regression_gate(json.dumps(cur))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" in out.err
+    last = out.out.strip().splitlines()[-1]
+    emitted = json.loads(last)
+    assert emitted["perf_regressions"][0]["scenario"] == "primary"
+
+
+def test_gate_geometry_change_not_a_regression(capsys):
+    prev = _newest_artifact()
+    if prev is None:
+        import pytest
+        pytest.skip("no driver artifact in tree")
+    bench = _load_bench()
+    cur = {"metric": "aggregate_commits_per_sec_31337_groups_9_peers",
+           "value": 1.0, "platform": prev.get("platform"),
+           "scenarios": {}}
+    bench._regression_gate(json.dumps(cur))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert "not comparable" in out.err
+    assert not out.out.strip()  # no augmented line re-emitted
+
+
+def test_gate_healthy_is_silent(capsys):
+    prev = _newest_artifact()
+    if prev is None:
+        import pytest
+        pytest.skip("no driver artifact in tree")
+    bench = _load_bench()
+    cur = {"metric": prev["metric"], "value": prev["value"] * 10,
+           "scenario": prev.get("scenario"),
+           "platform": prev.get("platform"), "scenarios": {}}
+    bench._regression_gate(json.dumps(cur))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert not out.out.strip()
+
+
+def test_gate_scenario_subset_not_compared(capsys):
+    """A BENCH_SCENARIO=engine run reuses the primary metric string with
+    a different leading scenario — it must read not-comparable, not as a
+    regression against the previous round's uniform primary."""
+    prev = _newest_artifact()
+    if prev is None:
+        import pytest
+        pytest.skip("no driver artifact in tree")
+    bench = _load_bench()
+    cur = {"metric": prev["metric"], "value": 1.0,
+           "scenario": "engine-only-run",
+           "platform": prev.get("platform"), "scenarios": {}}
+    bench._regression_gate(json.dumps(cur))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert "not comparable" in out.err
