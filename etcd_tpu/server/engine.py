@@ -171,6 +171,21 @@ class EngineConfig:
     # commit completes within the round it was staged, cutting ack
     # latency from ~4 round-trips to ~1.5 (kernel.step_routed_auto).
     hops: int = 3
+    # Compact readback (kernel.step_routed_compact): the round's state
+    # diff is computed ON DEVICE and the host reads back a (G, P) uint8
+    # flag map plus values for only the rows that changed, instead of
+    # the full O(G*P*W) state every round (32 MB of ring alone at
+    # G=100k — the term that dominates ack latency when the device is
+    # behind a network tunnel). Rounds that change more rows than
+    # compact_cap — or that raise need_host — fall back to the full
+    # readback, so saturated throughput is untouched. None = auto
+    # (enabled when mesh is None); the mesh path keeps full readback
+    # (its readback is sharded-resident and the flag map would need its
+    # own out_sharding).
+    compact_readback: Optional[bool] = None
+    # Max changed+staged rows served by the gather path before a round
+    # falls back to full readback. 0 = auto: max(2048, G*P//8).
+    compact_cap: int = 0
 
 
 class MultiEngine:
@@ -233,6 +248,22 @@ class MultiEngine:
                 lambda st, inbox, pc, ps, t: kernel.step_routed_auto(
                     self.kcfg, st, inbox, pc, ps, t, self.drop_mask,
                     self.cfg.hops))
+        self._compact = (cfg.compact_readback if cfg.compact_readback
+                         is not None else cfg.mesh is None)
+        if cfg.mesh is not None:
+            self._compact = False    # see EngineConfig.compact_readback
+        self._compact_cap = cfg.compact_cap or max(2048, G * P // 8)
+        # Set whenever device state was mutated WITHOUT updating the
+        # h_* mirrors (the snapshot-install surgery leaves mirrors stale
+        # on purpose so the NEXT round's full diff journals the install,
+        # _service_need_host). A compact diff is device-vs-device and
+        # would never see the surgery — the next round must take the
+        # full-readback path to re-sync mirrors and journal it.
+        self._force_full = False
+        self._step_fn_c = (
+            lambda st, inbox, pc, ps, t: kernel.step_routed_compact(
+                self.kcfg, st, inbox, pc, ps, t, self.drop_mask,
+                self.cfg.hops))
 
         # Geometry guard BEFORE anything touches the data dir: a mismatch
         # must refuse the dir before the WAL opens/creates any file in it.
@@ -1079,10 +1110,17 @@ class MultiEngine:
         # -- 2. the kernel round (fused step + routing: one ASYNC
         # dispatch; jax queues it and returns immediately) ----------------
         tick = (self.round_no % self.cfg.ticks_per_round) == 0
-        st, inbox = self._step_fn(
-            self.st, self.inbox,
-            jnp.asarray(prop_count), jnp.asarray(prop_slot),
-            jnp.asarray(bool(tick)))
+        flags_d = anh_d = None
+        if self._compact:
+            st, inbox, flags_d, anh_d = self._step_fn_c(
+                self.st, self.inbox,
+                jnp.asarray(prop_count), jnp.asarray(prop_slot),
+                jnp.asarray(bool(tick)))
+        else:
+            st, inbox = self._step_fn(
+                self.st, self.inbox,
+                jnp.asarray(prop_count), jnp.asarray(prop_slot),
+                jnp.asarray(bool(tick)))
         self.st = st
         self.inbox = inbox
         t_now = time.perf_counter()
@@ -1091,107 +1129,114 @@ class MultiEngine:
 
         # -- 3. read back round k (blocks until the device finishes; the
         # GIL is released while waiting, so the applier thread makes
-        # progress on earlier rounds' committed work here) ----------------
-        (term, vote, commit, state, last, ring, need_host) = (
-            np.array(a) for a in
-            self._jax.device_get((st.term, st.vote, st.commit, st.state,
-                                  st.last_index, st.log_term, st.need_host)))
-        t_now = time.perf_counter()
-        ph["readback"] = ph.get("readback", 0.0) + (t_now - t_ph)
-        t_ph = t_now
+        # progress on earlier rounds' committed work here). Compact mode
+        # reads the on-device diff flags first and fetches values for
+        # only the changed rows; need_host rounds and rounds changing
+        # more rows than the cap take the full readback below. ----------
+        rec = None
+        need_host = None
+        if self._compact:
+            # Check the 1-byte attestation BEFORE pulling the flag map:
+            # need-host/post-surgery rounds take the full readback anyway
+            # and must not pay a discarded (G, P) transfer first.
+            if not bool(anh_d) and not self._force_full:
+                flags_np = np.asarray(flags_d)
+                t_now = time.perf_counter()
+                ph["readback"] = ph.get("readback", 0.0) + (t_now - t_ph)
+                t_ph = t_now
+                rec = self._compact_record_admit(flags_np, staged_gs,
+                                                 staged_ss)
+                if rec is not None:
+                    t_now = time.perf_counter()
+                    ph["record"] = ph.get("record", 0.0) + (t_now - t_ph)
+                    t_ph = t_now
+        if rec is None:
+            (term, vote, commit, state, last, ring, need_host) = (
+                np.array(a) for a in
+                self._jax.device_get(
+                    (st.term, st.vote, st.commit, st.state,
+                     st.last_index, st.log_term, st.need_host)))
+            t_now = time.perf_counter()
+            ph["readback"] = ph.get("readback", 0.0) + (t_now - t_ph)
+            t_ph = t_now
 
-        # Violation check FIRST — before this round's WAL append, applies,
-        # or acks: a flagged round's commits come from state the kernel
-        # just classified as untrustworthy, and must never reach clients.
-        if need_host.any():
-            from etcd_tpu.ops.state import NH_VIOLATION
-            viol = (need_host & NH_VIOLATION) != 0
-            if viol.any():
-                self._fail_violation(viol)
+            # Violation check FIRST — before this round's WAL append,
+            # applies, or acks: a flagged round's commits come from state
+            # the kernel just classified as untrustworthy, and must never
+            # reach clients.
+            if need_host.any():
+                from etcd_tpu.ops.state import NH_VIOLATION
+                viol = (need_host & NH_VIOLATION) != 0
+                if viol.any():
+                    self._fail_violation(viol)
 
-        # -- 5. durable round record --------------------------------------
-        rec = RoundRecord(round_no=self.round_no)
-        chg = (term != self.h_term) | (vote != self.h_vote) | \
-              (commit != self.h_commit)
-        gi, pi = np.nonzero(chg)
-        rec.hs_g, rec.hs_p = gi.astype(np.uint32), pi.astype(np.uint16)
-        rec.hs_term = term[gi, pi].astype(np.uint32)
-        rec.hs_vote = vote[gi, pi].astype(np.uint16)
-        rec.hs_commit = commit[gi, pi].astype(np.uint32)
+            # -- 5. durable round record ----------------------------------
+            rec = RoundRecord(round_no=self.round_no)
+            chg = (term != self.h_term) | (vote != self.h_vote) | \
+                  (commit != self.h_commit)
+            gi, pi = np.nonzero(chg)
+            rec.hs_g, rec.hs_p = gi.astype(np.uint32), pi.astype(np.uint16)
+            rec.hs_term = term[gi, pi].astype(np.uint32)
+            rec.hs_vote = vote[gi, pi].astype(np.uint16)
+            rec.hs_commit = commit[gi, pi].astype(np.uint32)
 
-        last_chg = last != self.h_last
-        gi, pi = np.nonzero(last_chg)
-        rec.last_g, rec.last_p = gi.astype(np.uint32), pi.astype(np.uint16)
-        rec.last_v = last[gi, pi].astype(np.uint32)
+            last_chg = last != self.h_last
+            gi, pi = np.nonzero(last_chg)
+            rec.last_g = gi.astype(np.uint32)
+            rec.last_p = pi.astype(np.uint16)
+            rec.last_v = last[gi, pi].astype(np.uint32)
 
-        # Ring diff in two stages: a vectorized per-row any-reduction
-        # finds the rows whose ring changed (SIMD compare — NOT the 3-axis
-        # np.nonzero over (G, P, W) that dominated host cost at 100k
-        # groups), then the slot-level diff runs only on those rows. The
-        # full compare is required for correctness: an equal-length
-        # conflict overwrite can change ring terms in a round where that
-        # row's term/vote/commit/last are ALL unchanged (the follower
-        # adopted the new leader's term in an earlier round), so a
-        # HardState-based row filter would silently drop the overwrite
-        # from the WAL and crash replay would resurrect superseded
-        # entries.
-        act_g, act_p = np.nonzero(np.any(ring != self.h_ring, axis=2))
-        if len(act_g):
-            sub = ring[act_g, act_p] != self.h_ring[act_g, act_p]
-            ai, wi = np.nonzero(sub)
-            gi, pi = act_g[ai], act_p[ai]
-            lastv = last[gi, pi]
-            # ring slot w holds absolute index i = last - ((last - w) mod W)
-            absi = lastv - ((lastv - wi) % W)
-            keep = absi >= 1
-            rec.ring_g = gi[keep].astype(np.uint32)
-            rec.ring_p = pi[keep].astype(np.uint16)
-            rec.ring_i = absi[keep].astype(np.uint32)
-            rec.ring_t = ring[gi[keep], pi[keep], wi[keep]].astype(np.uint32)
+            # Ring diff in two stages: a vectorized per-row any-reduction
+            # finds the rows whose ring changed (SIMD compare — NOT the
+            # 3-axis np.nonzero over (G, P, W) that dominated host cost
+            # at 100k groups), then the slot-level diff runs only on
+            # those rows. The full compare is required for correctness:
+            # an equal-length conflict overwrite can change ring terms in
+            # a round where that row's term/vote/commit/last are ALL
+            # unchanged (the follower adopted the new leader's term in an
+            # earlier round), so a HardState-based row filter would
+            # silently drop the overwrite from the WAL and crash replay
+            # would resurrect superseded entries.
+            act_g, act_p = np.nonzero(np.any(ring != self.h_ring, axis=2))
+            if len(act_g):
+                sub = ring[act_g, act_p] != self.h_ring[act_g, act_p]
+                ai, wi = np.nonzero(sub)
+                gi, pi = act_g[ai], act_p[ai]
+                lastv = last[gi, pi]
+                # ring slot w holds absolute index
+                # i = last - ((last - w) mod W)
+                absi = lastv - ((lastv - wi) % W)
+                keep = absi >= 1
+                rec.ring_g = gi[keep].astype(np.uint32)
+                rec.ring_p = pi[keep].astype(np.uint16)
+                rec.ring_i = absi[keep].astype(np.uint32)
+                rec.ring_t = ring[gi[keep], pi[keep],
+                                  wi[keep]].astype(np.uint32)
 
-        # Index assignment for admitted proposals: a pre-existing leader
-        # admits in order at prev_last+1.. (its last_index can move this
-        # round ONLY by admission: it was already leader, so no no-op, and
-        # leaders ignore MsgApp).
-        requeue: List[Tuple[int, List[Tuple[int, bytes]]]] = []
-        if self._staged:
-            # Batch-gather the admission scalars: one fancy-indexed pull
-            # per array instead of 6 numpy scalar reads per staged group,
-            # reusing the index arrays built at staging time.
-            gs, ss = staged_gs, staged_ss
-            t_gs = term[gs, ss]
-            adm_l = np.where((state[gs, ss] == _LEADER)
-                             & (t_gs == self.h_term[gs, ss]),
-                             last[gs, ss] - self.h_last[gs, ss],
-                             0).tolist()
-            t_l = t_gs.tolist()
-            base_l = self.h_last[gs, ss].tolist()
-            for (g, (_, ents)), admitted, t, base in zip(
-                    self._staged.items(), adm_l, t_l, base_l):
-                for j, items in enumerate(ents):
-                    if j < admitted:
-                        i = base + 1 + j
-                        payload = _pack_entry(items)
-                        self.payloads[(g, i, t)] = payload
-                        if payload[0] != P_CONF:
-                            reqs = [it[2] for it in items]
-                            if None not in reqs:
-                                self.payload_reqs[(g, i, t)] = reqs
-                        rec.entries.append((g, i, t, payload))
-                    else:
-                        requeue.append(
-                            (g, [it for e in ents[j:] for it in e]))
-                        break
-        with self._lock:
-            for g, rest in requeue:
-                self._pending[g].extendleft(reversed(rest))
-                self._dirty.add(g)
+            # Index assignment for admitted proposals: a pre-existing
+            # leader admits in order at prev_last+1.. (its last_index can
+            # move this round ONLY by admission: it was already leader,
+            # so no no-op, and leaders ignore MsgApp).
+            if self._staged:
+                # Batch-gather the admission scalars: one fancy-indexed
+                # pull per array instead of 6 numpy scalar reads per
+                # staged group, reusing the index arrays built at staging
+                # time.
+                gs, ss = staged_gs, staged_ss
+                t_gs = term[gs, ss]
+                adm_l = np.where((state[gs, ss] == _LEADER)
+                                 & (t_gs == self.h_term[gs, ss]),
+                                 last[gs, ss] - self.h_last[gs, ss],
+                                 0).tolist()
+                self._admit_staged(rec, adm_l, t_gs.tolist(),
+                                   self.h_last[gs, ss].tolist())
 
-        self.h_term, self.h_vote, self.h_commit = term, vote, commit
-        self.h_state, self.h_last, self.h_ring = state, last, ring
-        t_now = time.perf_counter()
-        ph["record"] = ph.get("record", 0.0) + (t_now - t_ph)
-        t_ph = t_now
+            self.h_term, self.h_vote, self.h_commit = term, vote, commit
+            self.h_state, self.h_last, self.h_ring = state, last, ring
+            self._force_full = False   # mirrors == device state again
+            t_now = time.perf_counter()
+            ph["record"] = ph.get("record", 0.0) + (t_now - t_ph)
+            t_ph = t_now
 
         # -- 6. persist, then apply+ack. WAL fsync strictly precedes the
         # acks of everything this round committed (doc.go:31-39 ordering);
@@ -1219,8 +1264,9 @@ class MultiEngine:
 
         # -- 7. need_host: snapshot-install lagging followers (violations
         # already failed the round before anything was persisted or
-        # acked).
-        if need_host.any():
+        # acked). need_host is None on a compact round — the device
+        # already attested any_need_host == False for it.
+        if need_host is not None and need_host.any():
             self._service_need_host(need_host)
 
         ph["tail"] = ph.get("tail", 0.0) + (time.perf_counter() - t_ph)
@@ -1234,6 +1280,126 @@ class MultiEngine:
             self._drain_applies()    # checkpoint state must be consistent
             self._checkpoint()
             self._gc_payloads()
+
+    def _admit_staged(self, rec: RoundRecord, adm_l: list, t_l: list,
+                      base_l: list) -> None:
+        """Turn this round's staged entries into payload-store entries +
+        WAL records (admitted) or requeue them (rejected: the group's
+        leader changed or throttled admission). Shared by the full- and
+        compact-readback tails; iteration order is self._staged's
+        insertion order, which both tails' scalar lists follow."""
+        requeue: List[Tuple[int, List[Tuple[int, bytes]]]] = []
+        for (g, (_, ents)), admitted, t, base in zip(
+                self._staged.items(), adm_l, t_l, base_l):
+            for j, items in enumerate(ents):
+                if j < admitted:
+                    i = base + 1 + j
+                    payload = _pack_entry(items)
+                    self.payloads[(g, i, t)] = payload
+                    if payload[0] != P_CONF:
+                        reqs = [it[2] for it in items]
+                        if None not in reqs:
+                            self.payload_reqs[(g, i, t)] = reqs
+                    rec.entries.append((g, i, t, payload))
+                else:
+                    requeue.append(
+                        (g, [it for e in ents[j:] for it in e]))
+                    break
+        if requeue:
+            with self._lock:
+                for g, rest in requeue:
+                    self._pending[g].extendleft(reversed(rest))
+                    self._dirty.add(g)
+
+    def _compact_record_admit(self, flags: np.ndarray,
+                              staged_gs, staged_ss
+                              ) -> Optional[RoundRecord]:
+        """The compact-readback round tail: build the SAME durable round
+        record (byte-identical; tests/test_engine_compact.py pins it)
+        and run the same admission as the full tail, from a bounded
+        gather of only the rows the device flagged as changed. Returns
+        None when the round changed more rows than the cap — the caller
+        then falls back to the full readback (saturation: the bulk
+        transfer is amortized by the batch it carries)."""
+        kernel = self._kernel
+        jnp = self._jnp
+        G, P, W = self.cfg.groups, self.cfg.peers, self.cfg.window
+        chg_g, chg_p = np.nonzero(flags)
+        lin = chg_g.astype(np.int64) * P + chg_p
+        if staged_gs is not None:
+            lin = np.unique(np.concatenate(
+                [lin, staged_gs * P + staged_ss]))
+        K = len(lin)
+        if K > self._compact_cap:
+            return None
+        rec = RoundRecord(round_no=self.round_no)
+        if K == 0:
+            return rec
+        gi = (lin // P).astype(np.int32)
+        pi = (lin % P).astype(np.int32)
+        # Pad to a size bucket so gather_rows retraces O(log K) times,
+        # not per distinct K. Padding rows read (0, 0) — discarded.
+        Kp = 256
+        while Kp < K:
+            Kp <<= 1
+        gi_p = np.zeros(Kp, np.int32)
+        pi_p = np.zeros(Kp, np.int32)
+        gi_p[:K], pi_p[:K] = gi, pi
+        t_k, v_k, c_k, s_k, l_k, r_k = (
+            np.asarray(a)[:K] for a in kernel.gather_rows(
+                self.st, jnp.asarray(gi_p), jnp.asarray(pi_p)))
+
+        def rows(bit):
+            g, p = np.nonzero((flags & bit) != 0)
+            return g, p, np.searchsorted(lin, g.astype(np.int64) * P + p)
+
+        g0, p0, pos0 = rows(kernel.CHG_HS)
+        rec.hs_g = g0.astype(np.uint32)
+        rec.hs_p = p0.astype(np.uint16)
+        rec.hs_term = t_k[pos0].astype(np.uint32)
+        rec.hs_vote = v_k[pos0].astype(np.uint16)
+        rec.hs_commit = c_k[pos0].astype(np.uint32)
+
+        g1, p1, pos1 = rows(kernel.CHG_LAST)
+        rec.last_g = g1.astype(np.uint32)
+        rec.last_p = p1.astype(np.uint16)
+        rec.last_v = l_k[pos1].astype(np.uint32)
+
+        g2, p2, pos2 = rows(kernel.CHG_RING)
+        if len(g2):
+            new_rows = r_k[pos2]                    # (n2, W)
+            sub = new_rows != self.h_ring[g2, p2]
+            ai, wi = np.nonzero(sub)
+            lastv = l_k[pos2][ai]
+            absi = lastv - ((lastv - wi) % W)
+            keep = absi >= 1
+            rec.ring_g = g2[ai][keep].astype(np.uint32)
+            rec.ring_p = p2[ai][keep].astype(np.uint16)
+            rec.ring_i = absi[keep].astype(np.uint32)
+            rec.ring_t = new_rows[ai, wi][keep].astype(np.uint32)
+
+        if self._staged:
+            pos_s = np.searchsorted(lin, staged_gs * P + staged_ss)
+            t_gs = t_k[pos_s]
+            adm_l = np.where((s_k[pos_s] == _LEADER)
+                             & (t_gs == self.h_term[staged_gs, staged_ss]),
+                             l_k[pos_s]
+                             - self.h_last[staged_gs, staged_ss],
+                             0).tolist()
+            self._admit_staged(
+                rec, adm_l, t_gs.tolist(),
+                self.h_last[staged_gs, staged_ss].tolist())
+
+        # Mirror update LAST (admission reads the pre-round mirrors).
+        # Gathered values are authoritative for every union row —
+        # writing back an unchanged staged row is a no-op.
+        self.h_term[gi, pi] = t_k
+        self.h_vote[gi, pi] = v_k
+        self.h_commit[gi, pi] = c_k
+        self.h_state[gi, pi] = s_k
+        self.h_last[gi, pi] = l_k
+        self.h_ring[gi, pi] = r_k
+        return rec
 
     # ------------------------------------------------------------------
     # apply
@@ -1625,6 +1791,11 @@ class MultiEngine:
                 touched = True
         nh = np.zeros_like(need_host)
         if touched:
+            # Mirrors stay pre-surgery (see NOTE below); the next round
+            # must therefore run the FULL readback so its diff journals
+            # the install — a compact (device-vs-device) diff cannot see
+            # surgery that happened between rounds.
+            self._force_full = True
             self.st = st._replace(
                 term=self._dev("term", term), vote=self._dev("vote", vote),
                 commit=self._dev("commit", commit),

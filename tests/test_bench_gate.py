@@ -24,7 +24,8 @@ def _newest_artifact():
     import glob
     import re
     arts = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
-                  key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+                  key=lambda p: int(re.search(r"r(\d+)",
+                            os.path.basename(p)).group(1)))
     for p in reversed(arts):
         with open(p) as f:
             parsed = json.load(f).get("parsed")
