@@ -47,6 +47,14 @@ class FrameTransport:
                       if int(h) != host_id}
         self.on_frame = on_frame
         self.report_unreachable = report_unreachable or (lambda h: None)
+        # Partition injection (the reference's iptables isolation,
+        # pkg/netutil/isolate_linux.go:23-44 / etcd-tester failure.go
+        # isolate classes): host ids here are ALIVE BUT UNREACHABLE —
+        # outgoing frames to them are dropped at enqueue and incoming
+        # frames from them are dropped at delivery, both directions,
+        # while the processes keep running. Tests/chaos flip this set.
+        self.blocked: set = set()
+        self.blocked_dropped = 0
         self._stop = threading.Event()
         self._qs: Dict[int, deque] = {h: deque(maxlen=_MAX_QUEUE)
                                       for h in self.peers}
@@ -72,6 +80,9 @@ class FrameTransport:
         """Nonblocking: enqueue or drop-oldest (bounded queue). Loss is
         legal — PROPOSE loss surfaces as a client timeout, PAYLOAD loss is
         repaired by PULL."""
+        if to in self.blocked:
+            self.blocked_dropped += 1
+            return
         q = self._qs.get(to)
         if q is None:
             return
@@ -165,6 +176,9 @@ class FrameTransport:
             blob = self._recv_all(conn, blen) if blen else b""
             if hj is None or (blen and blob is None):
                 break
+            if frm in self.blocked:
+                self.blocked_dropped += 1
+                continue     # partition injection: read, never deliver
             try:
                 self.on_frame(frm, json.loads(hj.decode()), blob or b"")
             except Exception:  # noqa: BLE001 — a bad frame must not kill rx

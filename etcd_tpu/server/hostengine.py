@@ -113,6 +113,28 @@ class HostEngineConfig:
     # during a mass catch-up, e.g. a host restarting with an empty disk).
     snap_interval: float = 1.0
     snaps_per_round: int = 128
+    # Consensus data plane:
+    #   "collective" — the kernel state shards over a global N-host mesh
+    #     and votes/appends/acks ride an XLA all_to_all (the dense SPMD
+    #     plane). One dead host stalls EVERY group until the supervisor
+    #     restarts the whole job (~30 s measured): availability is traded
+    #     for zero-serialization consensus.
+    #   "frames" — every host runs the FULL (G, P) kernel on its own
+    #     device, authoritative for its own peer-slot column only, and
+    #     the per-round mailbox metadata rides the frame transport like
+    #     payloads already do (sparse-encoded per-peer slices). No
+    #     collective, no global process group: hosts fail INDEPENDENTLY
+    #     exactly like reference members (rafthttp peers, peer.go:87-190)
+    #     — a dead host's frames just stop, its groups' leaders re-elect
+    #     among the survivors within the election timeout, and quorum
+    #     n/2+1 keeps committing throughout (raft.go:323-332 semantics).
+    #     The dead host rejoins by simply restarting: probes repair its
+    #     lag via appends, or the snapshot-install path ships images.
+    #     Cost: each host steps P columns but exports only its own (the
+    #     P-1 ghost columns evolve as message-starved candidates and are
+    #     never read), and metadata latency is frame-paced rather than
+    #     ICI-paced.
+    data_plane: str = "collective"
 
 
 class HostEngine:
@@ -136,25 +158,48 @@ class HostEngine:
             election_tick=cfg.election_tick,
             heartbeat_tick=cfg.heartbeat_tick)
 
-        devs = sorted(jax.devices(), key=lambda d: d.process_index)
-        if len(devs) != Pn:
-            raise ValueError(
-                f"multi-host engine needs one device per peer slot: "
-                f"{len(devs)} devices for peers={Pn}")
-        assert len(jax.local_devices()) == 1, "one device per host expected"
+        self._frames_plane = cfg.data_plane == "frames"
         self.my_slot = cfg.host_id
-        assert devs[self.my_slot].process_index == jax.process_index(), (
-            "host_id must equal jax process index (device ordering)")
-        self.mesh = Mesh(np.array(devs).reshape(1, Pn),
-                         axis_names=("groups", "peers"))
-        self._st_sh = state_sharding(self.mesh)
-        self._mb_sh = mailbox_sharding(self.mesh)
-        self._cnt_sh = NamedSharding(self.mesh, P("groups", "peers"))
-        self._step_fn = jax.jit(
-            functools.partial(kernel.step_routed_slots_auto.__wrapped__,
-                              self.kcfg, hops=cfg.hops),
-            donate_argnums=(0, 1),
-            out_shardings=(self._st_sh, self._mb_sh))
+        if self._frames_plane:
+            # Local full-(G, P) kernel on this host's own device: no
+            # global mesh, no process group — the mailbox rides frames
+            # (see HostEngineConfig.data_plane). Several frames-plane
+            # engines can even share one process/device (tests do).
+            if cfg.hops != 1:
+                raise ValueError("frames data plane requires hops=1 "
+                                 "(persist-before-send across hosts)")
+            self.mesh = None
+            self._st_sh = self._mb_sh = self._cnt_sh = None
+            self._step_fn = jax.jit(
+                functools.partial(kernel.step_routed_slots_auto.__wrapped__,
+                                  self.kcfg, hops=1),
+                donate_argnums=(0, 1))
+            # Per-sender queues of sparse mailbox frames (bounded: a
+            # slower host drops OLDEST — raft retransmits; reference
+            # drop-on-full, peer.go:156-165) + our own self-loop slice.
+            self._meta_rx: Dict[int, deque] = {}
+            self._self_loop: Optional[np.ndarray] = None
+        else:
+            devs = sorted(jax.devices(), key=lambda d: d.process_index)
+            if len(devs) != Pn:
+                raise ValueError(
+                    f"multi-host engine needs one device per peer slot: "
+                    f"{len(devs)} devices for peers={Pn}")
+            assert len(jax.local_devices()) == 1, \
+                "one device per host expected"
+            assert devs[self.my_slot].process_index == \
+                jax.process_index(), (
+                "host_id must equal jax process index (device ordering)")
+            self.mesh = Mesh(np.array(devs).reshape(1, Pn),
+                             axis_names=("groups", "peers"))
+            self._st_sh = state_sharding(self.mesh)
+            self._mb_sh = mailbox_sharding(self.mesh)
+            self._cnt_sh = NamedSharding(self.mesh, P("groups", "peers"))
+            self._step_fn = jax.jit(
+                functools.partial(kernel.step_routed_slots_auto.__wrapped__,
+                                  self.kcfg, hops=cfg.hops),
+                donate_argnums=(0, 1),
+                out_shardings=(self._st_sh, self._mb_sh))
 
         self._check_geometry()
         self.wal = EngineWAL(cfg.data_dir, fsync=cfg.fsync)
@@ -221,10 +266,13 @@ class HostEngine:
         floor = self._load_term_floor() if ckpt is None else None
         if ckpt is not None or recs or floor is not None:
             self._restore(base, ckpt_round, ckpt, recs, floor)
+        elif self._frames_plane:
+            self.st = base
         else:
             self.st = shard_state(base, self.mesh)
         inbox0 = jnp.zeros((G, Pn, Pn, self.kcfg.fields), jnp.int32)
-        self.inbox = jax.device_put(inbox0, self._mb_sh)
+        self.inbox = (inbox0 if self._frames_plane
+                      else jax.device_put(inbox0, self._mb_sh))
 
     # ------------------------------------------------------------------
     # boot / restore
@@ -251,10 +299,16 @@ class HostEngine:
             os.replace(tmp, path)
 
     def _global_col(self, name: str, base_field, local_col: np.ndarray):
-        """Assemble a global sharded array where THIS host's column holds
-        restored local data; every host calls this for its own column."""
+        """Assemble a state array where THIS host's column holds restored
+        local data; every host calls this for its own column. (Frames
+        plane: the other columns keep base values — they are local
+        ghosts, never exported.)"""
         jax = self._jax
         base_np = np.asarray(base_field)
+        if self._frames_plane:
+            blk = base_np.copy()
+            blk[:, self.my_slot] = local_col
+            return self._jnp.asarray(blk)
         sh = getattr(self._st_sh, name)
 
         def cb(index):
@@ -375,7 +429,8 @@ class HostEngine:
         self._apply_committed(trigger=False, hist=hist)
         self._gc_payloads()
 
-        st = shard_state(base, self.mesh)
+        st = (base if self._frames_plane
+              else shard_state(base, self.mesh))
         self.st = st._replace(
             term=self._global_col("term", base.term, self.l_term),
             vote=self._global_col("vote", base.vote, self.l_vote),
@@ -413,6 +468,17 @@ class HostEngine:
 
     def _on_frame(self, frm: int, header: dict, blob: bytes) -> None:
         t = header.get("t")
+        if t == "meta":
+            # Frames-plane mailbox column from peer `frm`: one frame per
+            # sender round, consumed one per local round (the dense
+            # mailbox holds ONE message per (g, to, from) slot). Bounded
+            # backlog drops OLDEST — raft's retransmission machinery
+            # (heartbeats, probes) repairs exactly like a dropped packet.
+            q = self._meta_rx.get(frm)
+            if q is None:
+                q = self._meta_rx.setdefault(frm, deque(maxlen=16))
+            q.append(blob)
+            return
         if t == "pull":
             # Answer immediately from the payload store. Runs on the
             # transport rx thread while the engine thread may GC the
@@ -489,16 +555,25 @@ class HostEngine:
     # cross-host snapshot install (the rafthttp snapshot side-channel)
     # ------------------------------------------------------------------
 
-    @staticmethod
-    def _local(arr) -> np.ndarray:
-        """This host's shard (our peer-slot column) of a global array."""
+    def _local(self, arr) -> np.ndarray:
+        """This host's peer-slot column of a state array, shape
+        (G, 1, ...): the addressable shard on the collective plane, a
+        plain device slice on the frames plane."""
+        if self._frames_plane:
+            my = self.my_slot
+            return np.asarray(arr[:, my:my + 1])
         return np.asarray(list(arr.addressable_shards)[0].data)
 
     def _set_local(self, name: str, block: np.ndarray):
-        """New global array for state field `name` whose LOCAL shard (our
-        peer-slot column) is `block` — shape (G, 1, ...). Purely local:
-        every process only ever materializes its own shards, so no
-        collective is involved (same pattern as the need_host clearing)."""
+        """New array for state field `name` whose LOCAL column (our peer
+        slot) is `block` — shape (G, 1, ...). Purely local: on the
+        collective plane every process only ever materializes its own
+        shards, so no collective is involved (same pattern as the
+        need_host clearing); on the frames plane it is an at[].set."""
+        if self._frames_plane:
+            arr = getattr(self.st, name)
+            return arr.at[:, self.my_slot].set(
+                self._jnp.asarray(block[:, 0]))
         jax = self._jax
         sh = getattr(self._st_sh, name)
         gshape = (block.shape[0], self.cfg.peers) + block.shape[2:]
@@ -857,13 +932,44 @@ class HostEngine:
             self.frames.send(lead_host, {"t": "prop", "g": g, "hops": hops},
                              _pack_items(items))
 
-        cnt_gp = jax.make_array_from_callback(
-            (G, Pn), self._cnt_sh, lambda idx: cnt_local[idx[0], None])
-
-        # -- 2. the global SPMD round -------------------------------------
-        with self.mesh:
-            st, inbox = self._step_fn(self.st, self.inbox, cnt_gp,
-                                      jnp.asarray(True))
+        # -- 2. the consensus round: global SPMD collective, or the local
+        # full-(G, P) kernel with the mailbox riding frames ---------------
+        routed_my = None
+        if self._frames_plane:
+            my = self.my_slot
+            F = self.kcfg.fields
+            inbox_np = np.zeros((G, Pn, Pn, F), np.int32)
+            if self._self_loop is not None:
+                inbox_np[:, my, my] = self._self_loop
+            for j, q in list(self._meta_rx.items()):
+                if q:
+                    try:
+                        idx, vals = _unpack_meta(q.popleft(), F)
+                    except (ValueError, struct.error):
+                        log.warning("bad meta frame from host %d dropped",
+                                    j)
+                        continue
+                    ok = idx < G
+                    inbox_np[idx[ok], my, j] = vals[ok]
+            cnt = np.zeros((G, Pn), np.int32)
+            cnt[:, my] = cnt_local
+            st, inbox = self._step_fn(self.st, jnp.asarray(inbox_np),
+                                      jnp.asarray(cnt), jnp.asarray(True))
+            # Our column's sends to every peer column: routed
+            # inbox[g, to, from] at from == my. Sliced on device, read
+            # once; the rest of the routed mailbox is ghost traffic and
+            # never leaves the device — drop the buffer now (the frames
+            # plane rebuilds next round's inbox from frames; keeping the
+            # (G, P, P, F) array would pin dead device memory all round).
+            routed_my = np.asarray(inbox[:, :, my, :])     # (G, P, F)
+            self._self_loop = routed_my[:, my, :]
+            inbox = None
+        else:
+            cnt_gp = jax.make_array_from_callback(
+                (G, Pn), self._cnt_sh, lambda idx: cnt_local[idx[0], None])
+            with self.mesh:
+                st, inbox = self._step_fn(self.st, self.inbox, cnt_gp,
+                                          jnp.asarray(True))
         self.st = st
         self.inbox = inbox
 
@@ -967,6 +1073,20 @@ class HostEngine:
         if not rec.is_empty():
             self.wal.append(rec)
             self._recent_recs.append(rec)
+
+        # -- 6a. frames plane: ship this round's mailbox column AFTER the
+        # fsync above — the persist-before-send contract (doc.go:31-39)
+        # holds per-host exactly like the reference's Ready ordering.
+        # Sparse per-peer encoding: only groups with a live message.
+        if routed_my is not None:
+            for h in range(Pn):
+                if h == my:
+                    continue
+                msgs = routed_my[:, h, :]
+                idx = np.nonzero(msgs.any(axis=1))[0]
+                if len(idx):
+                    self.frames.send(h, {"t": "meta"},
+                                     _pack_meta(idx, msgs[idx]))
 
         # -- 6. fan out fresh local admissions ----------------------------
         if fresh_frames:
@@ -1244,6 +1364,27 @@ class HostEngine:
 # ---------------------------------------------------------------------------
 # frame payload packing
 # ---------------------------------------------------------------------------
+
+def _pack_meta(idx: np.ndarray, vals: np.ndarray) -> bytes:
+    """Sparse mailbox column frame: u32 count, then group indices (u32)
+    and per-group message fields (i32 x F). Only groups carrying a live
+    message are shipped — the quiescent steady state is a handful of
+    heartbeat rows, not G."""
+    return (struct.pack("<I", len(idx))
+            + np.ascontiguousarray(idx.astype("<u4")).tobytes()
+            + np.ascontiguousarray(vals.astype("<i4")).tobytes())
+
+
+def _unpack_meta(blob: bytes, fields: int) -> Tuple[np.ndarray, np.ndarray]:
+    (n,) = struct.unpack_from("<I", blob, 0)
+    need = 4 + 4 * n + 4 * n * fields
+    if len(blob) != need:
+        raise ValueError(f"meta frame length {len(blob)} != {need}")
+    idx = np.frombuffer(blob, "<u4", n, 4).astype(np.int64)
+    vals = np.frombuffer(blob, "<i4", n * fields,
+                         4 + 4 * n).reshape(n, fields)
+    return idx, vals
+
 
 def _pack_items(items: List[Tuple[int, bytes]]) -> bytes:
     out = [struct.pack("<I", len(items))]

@@ -29,23 +29,29 @@ def run_rank() -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     rank = int(os.environ["MHE_RANK"])
     n = int(os.environ["MHE_NHOSTS"])
-    coord = os.environ["MHE_COORD"]
     data = os.environ["MHE_DATA"]
     http_ports = [int(p) for p in os.environ["MHE_HTTP_PORTS"].split(",")]
     frame_ports = [int(p) for p in os.environ["MHE_FRAME_PORTS"].split(",")]
     groups = int(os.environ.get("MHE_GROUPS", "8"))
+    # MHE_PLANE=frames: the availability-first data plane — no global
+    # process group at all (a dead rank is just silent frames; survivors
+    # keep serving, see HostEngineConfig.data_plane). Default remains the
+    # collective SPMD plane.
+    plane = os.environ.get("MHE_PLANE", "collective")
 
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from etcd_tpu.utils.platform import enable_compile_cache
     enable_compile_cache()
-    print(f"rank {rank}: joining distributed ({coord})", flush=True)
-    jax.distributed.initialize(coordinator_address=coord, num_processes=n,
-                               process_id=rank)
+    if plane != "frames":
+        coord = os.environ["MHE_COORD"]
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        print(f"rank {rank}: joining distributed ({coord})", flush=True)
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=rank)
 
     from etcd_tpu.etcdhttp.tenants import EngineHttp
     from etcd_tpu.server.hostengine import HostEngine, HostEngineConfig
@@ -64,6 +70,7 @@ def run_rank() -> int:
         round_interval=float(os.environ.get("MHE_ROUND_INTERVAL", "0")),
         drop_pay_pct=float(os.environ.get("MHE_DROP_PAY_PCT", "0")),
         fault_seed=int(os.environ.get("MHE_FAULT_SEED", "0")) + rank,
+        data_plane=plane,
     )
     eng = HostEngine(cfg)
     http = EngineHttp(eng, port=http_ports[rank])
@@ -83,10 +90,11 @@ def run_rank() -> int:
         time.sleep(0.2)
     http.stop()
     eng.stop()
-    try:
-        jax.distributed.shutdown()
-    except Exception:  # noqa: BLE001 — peers may already be gone
-        pass
+    if plane != "frames":
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — peers may already be gone
+            pass
     return 0 if eng.failed is None else 1
 
 
