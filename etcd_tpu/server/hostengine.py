@@ -942,7 +942,16 @@ class HostEngine:
             if self._self_loop is not None:
                 inbox_np[:, my, my] = self._self_loop
             for j, q in list(self._meta_rx.items()):
-                if q:
+                # Normally one frame per sender round. When a backlog
+                # built up (transient stall on our side), drain up to 4
+                # per round — newer frames overwrite overlapping group
+                # rows (those rows are dropped packets; raft's
+                # heartbeat/probe machinery retransmits), so the queue
+                # recovers to fresh instead of serving permanently
+                # ~maxlen-round-stale mailboxes.
+                consumed = 0
+                while q and consumed < 4:
+                    consumed += 1
                     try:
                         idx, vals = _unpack_meta(q.popleft(), F)
                     except (ValueError, struct.error):

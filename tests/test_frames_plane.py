@@ -15,7 +15,6 @@ properties the collective SPMD plane trades away (whole-job restart,
 All engines here run in ONE process (the frames plane needs no global
 device mesh or process group — that is the point)."""
 import os
-import socket
 import sys
 import time
 
@@ -27,19 +26,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from etcd_tpu import errors  # noqa: E402
 from etcd_tpu.server.hostengine import HostEngine, HostEngineConfig  # noqa: E402
 from etcd_tpu.server.request import Request  # noqa: E402
+from etcd_tpu.tools.functional_tester import _free_ports  # noqa: E402
 
 G = 6
 N = 3
-
-
-def _free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
 
 
 def _mk(rank, ports, data, **kw):
