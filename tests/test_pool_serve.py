@@ -1,0 +1,118 @@
+"""Pool-sharded serving (scripts/pool_serve.py): K engine processes
+each own G/K tenants behind one router port — the single-host engine's
+documented multi-core deployment path made concrete. Checks the global
+tenant-id mapping, cross-shard isolation, and the per-shard failure
+domain (one shard dying 503s only its own tenants)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+G, K = 8, 2
+
+
+def _put(port, t, key, val, timeout=25):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/tenants/{t}/v2/keys{key}",
+        data=f"value={val}".encode(), method="PUT")
+    req.add_header("Content-Type", "application/x-www-form-urlencoded")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status
+
+
+def _get(port, t, key, timeout=25):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/tenants/{t}/v2/keys{key}",
+            timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_pool_sharded_serving(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "pool_serve.py"),
+         "--groups", str(G), "--shards", str(K),
+         "--data-dir", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        line = p.stdout.readline()
+        info = json.loads(line)
+        assert "error" not in info, info
+        port = info["router"]
+        pids = info["pids"]
+        assert info["per_shard"] == G // K
+
+        # Every GLOBAL tenant id writable through the one router port;
+        # same key, different tenants — isolation across the shard cut.
+        for t in range(G):
+            assert _put(port, t, "/k", f"v{t}") == 201
+        for t in range(G):
+            assert _get(port, t, "/k")["node"]["value"] == f"v{t}"
+
+        # Out-of-pool tenant id: the router rejects it, not a shard.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, G + 3, "/k")
+        assert ei.value.code == 404
+
+        # Pool-level surfaces are explicitly refused (one shard must not
+        # answer for the whole pool).
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/tenants", timeout=10):
+                pass
+        assert ei.value.code == 501
+
+        # Watch long-poll THROUGH the router: piped, not buffered — the
+        # event must arrive while the connection stays open.
+        import threading
+        got = {}
+
+        def watcher():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/tenants/5/v2/keys/wk"
+                        f"?wait=true", timeout=30) as r:
+                    got["event"] = json.loads(r.read())
+            except Exception as e:  # noqa: BLE001 — asserted below
+                got["error"] = e
+
+        th = threading.Thread(target=watcher, daemon=True)
+        th.start()
+        time.sleep(1.5)   # let the long-poll register on the shard
+        assert _put(port, 5, "/wk", "woke") == 201
+        th.join(timeout=30)
+        assert got.get("event", {}).get("node", {}).get("value") == \
+            "woke", got
+
+        # Kill shard 1: its tenants answer 503 (Retry-After), shard 0's
+        # tenants keep serving — per-shard failure domains.
+        os.kill(pids[1], signal.SIGKILL)
+        time.sleep(1.0)
+        deadline = time.time() + 30
+        saw_503 = False
+        while time.time() < deadline and not saw_503:
+            try:
+                _get(port, G - 1, "/k", timeout=5)
+                time.sleep(0.5)
+            except urllib.error.HTTPError as e:
+                saw_503 = e.code == 503
+            except OSError:
+                time.sleep(0.5)
+        assert saw_503, "dead shard's tenants never surfaced 503"
+        assert _get(port, 0, "/k")["node"]["value"] == "v0"
+        assert _put(port, 1, "/k2", "still-on") == 201
+    finally:
+        p.send_signal(signal.SIGTERM)
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
