@@ -708,7 +708,11 @@ def child_main() -> int:
     # comparatively quick synced loops.
     _WEIGHTS = {"uniform": 0.28, "zipf": 0.08, "lag": 0.08,
                 "engine": 0.24, "latency": 0.22, "churn": 0.10}
-    order = (["uniform", "zipf", "lag", "engine", "latency", "churn"]
+    # Serving scenarios directly after the primary: a time-boxed TPU run
+    # (tunnel flakes eat budget) must land the north-star engine/latency
+    # numbers before the quick synced loops, and churn stays last (its
+    # 7-peer geometry is a second cold compile).
+    order = (["uniform", "engine", "latency", "zipf", "lag", "churn"]
              if sel == "all" else [sel])
     remaining = deadline - time.time()
     shares = ([_WEIGHTS[sc] for sc in order] if len(order) > 1

@@ -229,14 +229,18 @@ def test_partition_isolated_majority_keeps_serving(tmp_path):
 
         for g in range(G):
             _put_retry(engines[2], g, f"/1/part{g}", "v1",
-                       t_part + 60, "partitioned")
+                       t_part + 150, "partitioned")
         assert (engines[0].frames.blocked_dropped
                 + engines[1].frames.blocked_dropped) > 0
 
         # Heal; the cut pair reconverges (payload pulls + appends).
         engines[0].frames.blocked.clear()
         engines[1].frames.blocked.clear()
-        deadline = time.time() + 60
+        # Generous deadlines: under full-suite contention on the one-core
+        # box, three engines' rounds stretch ~10x (the 13s solo runtime
+        # observed >60s in-suite) — the property is convergence, not
+        # speed.
+        deadline = time.time() + 150
         ok = False
         while time.time() < deadline and not ok:
             ok = True
