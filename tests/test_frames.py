@@ -209,3 +209,67 @@ def test_broadcast_reaches_every_peer():
     finally:
         for t in trs:
             t.stop()
+
+
+def test_blocked_partition_hook():
+    """Alive-but-unreachable injection: blocked peers are dropped at
+    send-enqueue AND at receive-delivery, both counted; clearing the set
+    restores the link without reconnect."""
+    (t0, s0), (t1, s1), _ = make_pair()
+    trs, sinks = [t0, t1], [s0, s1]
+    try:
+        assert wait_peers(trs[0])
+        trs[0].send(1, {"t": "pre"}, b"a")
+        assert sinks[1].wait_n(1)
+
+        # Outgoing block at 0.
+        trs[0].blocked.add(1)
+        trs[0].send(1, {"t": "dropped"}, b"b")
+        assert trs[0].blocked_dropped == 1
+        # Incoming block at 1: frame leaves 0 but is not delivered.
+        trs[0].blocked.clear()
+        trs[1].blocked.add(0)
+        trs[0].send(1, {"t": "undelivered"}, b"c")
+        deadline = time.time() + 5
+        while trs[1].blocked_dropped == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert trs[1].blocked_dropped >= 1
+        assert len(sinks[1].frames) == 1   # still only the pre frame
+
+        # Heal: traffic flows again on the same connection.
+        trs[1].blocked.clear()
+        trs[0].send(1, {"t": "post"}, b"d")
+        assert sinks[1].wait_n(2)
+        assert sinks[1].frames[1][1]["t"] == "post"
+    finally:
+        for t in trs:
+            t.stop()
+
+
+def test_meta_codec_roundtrip():
+    """The frames-plane sparse mailbox codec: indices + field rows
+    round-trip exactly; truncated or padded blobs are rejected (a
+    malformed frame must fail loud in _drain, not corrupt an inbox)."""
+    import numpy as np
+    import pytest
+
+    from etcd_tpu.server.hostengine import _pack_meta, _unpack_meta
+
+    F = 7
+    idx = np.asarray([3, 17, 4000], np.int64)
+    vals = np.arange(3 * F, dtype=np.int32).reshape(3, F) - 5
+    blob = _pack_meta(idx, vals)
+    idx2, vals2 = _unpack_meta(blob, F)
+    assert idx2.tolist() == idx.tolist()
+    assert (vals2 == vals).all()
+
+    empty_i, empty_v = _unpack_meta(
+        _pack_meta(np.zeros(0, np.int64), np.zeros((0, F), np.int32)), F)
+    assert len(empty_i) == 0 and empty_v.shape == (0, F)
+
+    with pytest.raises(ValueError):
+        _unpack_meta(blob[:-1], F)
+    with pytest.raises(ValueError):
+        _unpack_meta(blob + b"x", F)
+    with pytest.raises(ValueError):
+        _unpack_meta(blob, F + 1)

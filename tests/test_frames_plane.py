@@ -265,3 +265,71 @@ def test_partition_isolated_majority_keeps_serving(tmp_path):
                 e.stop()
             except Exception:  # noqa: BLE001
                 pass
+
+
+@pytest.mark.slow
+def test_chaos_soak_kill_partition_cycles(tmp_path):
+    """Seeded mini-soak of the frames plane: repeated host kills (with
+    restart) and a partition window, liveness asserted after every
+    injection — the availability property must hold across CYCLES, not
+    just one staged failure (reference etcd-tester runs failure rounds
+    in a loop, etcd-tester/tester.go)."""
+    import random
+    rng = random.Random(11)
+    ports = _free_ports(N)
+    engines = [_mk(r, ports, str(tmp_path)) for r in range(N)]
+    for e in engines:
+        e.start()
+    seq = 0
+    try:
+        _wait_all_leaders(engines)
+
+        def prove_all_serving(deadline_s, tag):
+            nonlocal seq
+            seq += 1
+            # Every engine must be healthy here — a silent mid-soak
+            # crash must fail the test, not shrink the write pool.
+            for e in engines:
+                assert e.failed is None, (tag, e.my_slot, e.failed)
+                assert e._thread is not None and e._thread.is_alive(), \
+                    (tag, e.my_slot)
+            deadline = time.time() + deadline_s
+            for g in range(G):
+                _put_retry(engines[g % N], g,
+                           f"/1/soak{seq}_{g}", f"v{seq}", deadline, tag)
+
+        prove_all_serving(60, "baseline")
+        for cycle in range(2):
+            victim = rng.randrange(N)
+            engines[victim].stop()
+            # survivors serve through the outage
+            survivors = [engines[i] for i in range(N) if i != victim]
+            deadline = time.time() + 150
+            for g in range(G):
+                _put_retry(survivors[g % (N - 1)], g,
+                           f"/1/kill{cycle}_{g}", "k", deadline,
+                           f"kill-cycle-{cycle}")
+            # restart the victim; full pool healthy again
+            engines[victim] = _mk(victim, ports, str(tmp_path))
+            engines[victim].start()
+            prove_all_serving(150, f"post-restart-{cycle}")
+
+        # one partition window: isolate a random pair, majority serves
+        a = rng.randrange(N)
+        b = (a + 1) % N
+        c = next(i for i in range(N) if i not in (a, b))
+        engines[a].frames.blocked.add(b)
+        engines[b].frames.blocked.add(a)
+        deadline = time.time() + 150
+        for g in range(G):
+            _put_retry(engines[c], g, f"/1/iso{g}", "i", deadline,
+                       "partition-window")
+        engines[a].frames.blocked.clear()
+        engines[b].frames.blocked.clear()
+        prove_all_serving(150, "post-heal")
+    finally:
+        for e in engines:
+            try:
+                e.stop()
+            except Exception:  # noqa: BLE001
+                pass
