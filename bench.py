@@ -591,20 +591,21 @@ def child_main() -> int:
                 sample_rid(rid)
                 return (rid, b"\x00" + rq.encode(), rq)
 
-            def offer(r):
-                """Top pending queues up to E per group; sample one
-                fresh-id waiter's ack latency per round."""
+            def offer(r, depth=E, sample=True):
+                """Top pending queues up to `depth` per group; optionally
+                sample one fresh-id waiter's ack latency per round."""
                 nonlocal pool_i
-                item = fresh_sampled()
+                item = fresh_sampled() if sample else None
                 with eng._lock:
                     for g in range(G_e):
                         dq = eng._pending[g]
-                        while len(dq) < E:
+                        while len(dq) < depth:
                             dq.append(pool[pool_i & 4095])
                             pool_i += 1
                         eng._dirty.add(g)
-                    eng._pending[r % G_e].append(item)
-                    eng._dirty.add(r % G_e)
+                    if item is not None:
+                        eng._pending[r % G_e].append(item)
+                        eng._dirty.add(r % G_e)
 
             for r in range(5):   # warm the serving loop
                 offer(r)
@@ -625,16 +626,52 @@ def child_main() -> int:
                     break
             elapsed = time.time() - t0
             acked = eng.acked_requests - a0
-            # Drain phase A completely: queues empty + applier settled, so
-            # phase B starts from a quiescent engine.
-            for _ in range(200):
-                eng.run_round()
-                with eng._lock:
-                    if not any(eng._pending[g] for g in range(G_e)):
-                        break
-            eng._drain_applies()
+
+            def drain():
+                """Queues empty + applier settled: the next phase starts
+                from a quiescent engine."""
+                for _ in range(200):
+                    eng.run_round()
+                    with eng._lock:
+                        if not any(eng._pending[g] for g in range(G_e)):
+                            break
+                eng._drain_applies()
+
+            drain()
             sat_samples, samples = samples, []
             aps = acked / elapsed
+
+            # -- Phase A2 (engine scenario only): DEEP-QUEUE throughput.
+            # Depth E (above) models E in-flight requests per tenant —
+            # conservative next to the reference benchmark's hundreds of
+            # concurrent clients (Documentation/benchmarks: up to 1,000
+            # clients on ONE keyspace). At depth 64 the group commit
+            # packs ~16x larger entries and the per-entry host costs
+            # amortize; this phase reports what a busy tenant's pipeline
+            # actually sustains. Skipped when the scenario is out of
+            # budget, for the latency scenario (its budget belongs to
+            # the paced phase B), and at very large G_e (topping 100k
+            # queues to depth 64 is ~6M single-core Python appends per
+            # round — the phase would measure the generator, not the
+            # engine).
+            DEEP = 64
+            deep_aps = rd = None
+            if (label == "engine" and G_e * DEEP <= 2_000_000
+                    and time.time() < sc_deadline - 5.0):
+                deep_end = time.time() + 0.3 * (sc_deadline - time.time())
+                d0 = eng.acked_requests
+                t_d = time.time()
+                rd = 0
+                while time.time() < deep_end - 0.5 or rd < 5:
+                    offer(rd, depth=DEEP, sample=False)
+                    eng.run_round()
+                    rd += 1
+                    if rd >= 100000:
+                        break
+                deep_elapsed = time.time() - t_d
+                deep_acked = eng.acked_requests - d0
+                drain()
+                deep_aps = deep_acked / deep_elapsed
 
             # -- Phase B: latency AT LOAD — offered load paced to ~50% of
             # the measured saturated capacity (the standard way to report
@@ -680,13 +717,20 @@ def child_main() -> int:
                 if s_lats else None)
         sp99 = (round(1000 * float(np.percentile(s_lats, 99)), 3)
                 if s_lats else None)
+        deep_txt = (f"deep-queue (depth {DEEP}) {deep_aps:,.0f} writes/s "
+                    f"over {rd} rounds; " if deep_aps is not None else "")
         log(f"[{label}] G={G_e} P={P}: {acked} acked writes in "
             f"{elapsed:.2f}s / {r} rounds -> {aps:,.0f} writes/s "
-            f"(fsync on); ack latency at 50% load p50 {p50} p99 {p99} ms "
-            f"over {len(b_lats)} samples ({rb} paced rounds); "
-            f"saturated p50 {sp50} p99 {sp99} ms")
+            f"(fsync on, depth {E}); {deep_txt}ack latency at "
+            f"50% load p50 {p50} p99 {p99} ms over {len(b_lats)} samples "
+            f"({rb} paced rounds); saturated p50 {sp50} p99 {sp99} ms")
+        deep_keys = ({"deep_queue_acked_writes_per_sec": round(deep_aps, 1),
+                      "deep_queue_depth": DEEP,
+                      "deep_queue_rounds": rd}
+                     if deep_aps is not None else {})
         return {"acked_writes_per_sec": round(aps, 1),
                 "commits_per_sec": round(aps, 1),
+                **deep_keys,
                 "groups": G_e,
                 "rounds_pipelined": r,
                 "round_ms_pipelined": round(1000 * elapsed / max(r, 1), 3),
@@ -942,9 +986,44 @@ def _regression_gate(line: str) -> None:
         print(json.dumps(cur), flush=True)
 
 
+def _warn_orphans() -> None:
+    """A leaked `python -m etcd_tpu` member (e.g. a timeout-killed test
+    run's subprocess) time-slices this box's ONE core and silently skews
+    every number measured here — exactly what produced a 2x phantom
+    slowdown mid-round-5. Warn loudly; kill them first with
+    BENCH_KILL_ORPHANS=1 (safe on a dedicated bench box)."""
+    try:
+        import subprocess as _sp
+        out = _sp.run(["ps", "-eo", "pid,args"], capture_output=True,
+                      text=True, timeout=10).stdout
+        orphans = [ln.split(None, 1) for ln in out.splitlines()
+                   if "-m etcd_tpu" in ln or "multihost_engine" in ln]
+        orphans = [(int(p), a) for p, a in orphans
+                   if int(p) != os.getpid()]
+        if not orphans:
+            return
+        if os.environ.get("BENCH_KILL_ORPHANS") == "1":
+            import signal as _sig
+            for pid, _ in orphans:
+                try:
+                    os.kill(pid, _sig.SIGKILL)
+                except OSError:
+                    pass
+            log(f"killed {len(orphans)} orphan engine process(es) "
+                f"before measuring")
+        else:
+            log(f"WARNING: {len(orphans)} stray engine process(es) are "
+                f"sharing this core — numbers below are contended "
+                f"(pids {[p for p, _ in orphans]}; "
+                f"BENCH_KILL_ORPHANS=1 removes them)")
+    except Exception:  # noqa: BLE001 — diagnostics must not break bench
+        pass
+
+
 def main() -> int:
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
+    _warn_orphans()
 
     # Best-effort native build (~2s, idempotent): the engine scenario is
     # 2.6x faster on the C store core, and a freshly cleaned tree has no
