@@ -74,13 +74,24 @@ P_MULTI = 0x02  # batched Requests: u32 count, then (u32 len, Request JSON)*
 _LEADER = 2  # ops.state.LEADER (kept in sync; imported lazily with jax)
 
 
+try:
+    from etcd_tpu.native.walcodec import pack_multi as _c_pack_multi
+except ImportError:          # pure-Python fallback (un-built tree)
+    _c_pack_multi = None
+
+
 def _pack_entry(items: List[tuple]) -> bytes:
     """One log entry's payload from its coalesced (rid, tagged-payload,
     ...) items: singletons keep their original tagged bytes (P_REQ/P_CONF,
     replay-compatible with pre-batching WALs); multi-request entries pack
-    as P_MULTI + u32 count + (u32 len + Request JSON)*."""
+    as P_MULTI + u32 count + (u32 len + Request JSON)*. The C packer
+    (walcodec.pack_multi, byte-identical — tests/test_native.py) carries
+    the deep-queue stage phase; the Python body is the un-built-tree
+    fallback and the reference implementation."""
     if len(items) == 1:
         return items[0][1]
+    if _c_pack_multi is not None:
+        return _c_pack_multi(items, P_MULTI)
     out = [bytes([P_MULTI]), struct.pack("<I", len(items))]
     for it in items:
         blob = it[1][1:]            # strip the P_REQ tag

@@ -70,3 +70,53 @@ def test_enginewal_replay_uses_codec(tmp_path):
     w3 = EngineWAL(str(tmp_path / "w"), fsync=False)
     got = list(w3.replay())
     assert [r.round_no for r in got] == list(range(4))
+
+
+def test_pack_multi_byte_identical():
+    """walcodec.pack_multi must produce exactly the Python reference
+    packing of server/engine._pack_entry's multi branch — WAL payloads
+    are replayed byte-for-byte and CRC-chained."""
+    import struct
+
+    from etcd_tpu.native.walcodec import pack_multi
+    from etcd_tpu.server.engine import P_MULTI
+
+    def py_pack(items):
+        out = [bytes([P_MULTI]), struct.pack("<I", len(items))]
+        for it in items:
+            blob = it[1][1:]
+            out.append(struct.pack("<I", len(blob)))
+            out.append(blob)
+        return b"".join(out)
+
+    cases = [
+        [(1, b"\x00" + b'{"id":1}')],
+        [(1, b"\x00" + b'{"id":1}'), (2, b"\x00" + b'{"id":2,"v":"x"}')],
+        [(i, b"\x00" + bytes([65 + (i % 26)]) * (i % 300 + 1), None)
+         for i in range(512)],
+        [(7, b"\x01")],                   # empty body after the tag
+    ]
+    for items in cases:
+        assert pack_multi(items, P_MULTI) == py_pack(items)
+
+    # And against the ACTUAL shipping fallback (not the copy above): a
+    # framing change to engine._pack_entry must fail here, or built and
+    # un-built trees would write divergent WAL entries.
+    import etcd_tpu.server.engine as engine_mod
+    saved = engine_mod._c_pack_multi
+    try:
+        engine_mod._c_pack_multi = None
+        for items in cases:
+            if len(items) > 1:
+                assert engine_mod._pack_entry(items) == \
+                    pack_multi(items, P_MULTI)
+    finally:
+        engine_mod._c_pack_multi = saved
+
+    import pytest
+    with pytest.raises(TypeError):
+        pack_multi([(1, "not-bytes")], P_MULTI)
+    with pytest.raises(TypeError):
+        pack_multi([(1, b"")], P_MULTI)   # payload must carry a tag byte
+    with pytest.raises(TypeError):
+        pack_multi([1], P_MULTI)

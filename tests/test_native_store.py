@@ -209,3 +209,25 @@ def test_watch_parity_through_native():
         w2 = st.watch("/w/a", since_index=1)
         e = w2.next_event(timeout=0.05)
         assert e is not None and e.action == "set" and e.node.key == "/w/a"
+
+
+def test_set_many_inline_canonical_predicate_matches_norm():
+    """set_applied_many's inline canonical-path fast check must accept a
+    path ONLY when _norm would return it unchanged — exhaustively over
+    every string up to length 6 from a hostile alphabet (slash, dot,
+    letter). A path the inline check wrongly passes through would reach
+    the C core un-canonicalized and create unreachable keys."""
+    import itertools
+
+    from etcd_tpu.store.native_store import _norm
+
+    def inline_ok(p):
+        return (p and p[0] == "/" and p[-1] != "/" and "//" not in p
+                and "." not in p)
+
+    alphabet = "/a."
+    for n in range(0, 7):
+        for tup in itertools.product(alphabet, repeat=n):
+            p = "".join(tup)
+            if inline_ok(p):
+                assert _norm(p) == p, p
