@@ -32,6 +32,7 @@ import signal
 import socketserver
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 
@@ -202,7 +203,13 @@ def main() -> int:
                       "groups": G, "per_shard": per}), flush=True)
 
     def on_term(signum, frame):
-        srv.shutdown()
+        # shutdown() BLOCKS until serve_forever exits; a signal handler
+        # runs ON the serve_forever thread, so calling it synchronously
+        # deadlocks — the router then never reaches the finally that
+        # terminates the shard processes, and a supervisor killing the
+        # stuck router leaks them (exactly how a shard orphan escaped a
+        # test teardown). Shut down from a helper thread instead.
+        threading.Thread(target=srv.shutdown, daemon=True).start()
 
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)

@@ -43,6 +43,7 @@ def test_pool_sharded_serving(tmp_path):
          "--data-dir", str(tmp_path)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         text=True)
+    info = {}
     try:
         line = p.stdout.readline()
         info = json.loads(line)
@@ -112,7 +113,23 @@ def test_pool_sharded_serving(tmp_path):
         assert _put(port, 1, "/k2", "still-on") == 201
     finally:
         p.send_signal(signal.SIGTERM)
+        router_reaped = True
         try:
             p.wait(timeout=30)
         except subprocess.TimeoutExpired:
             p.kill()
+            router_reaped = False
+        # Belt-and-braces ONLY when the router died without running its
+        # own finally (kill above): reap the shards directly — a leaked
+        # engine time-slices this box's one core and flakes every
+        # timing-sensitive test after this module. Identity-checked so
+        # a recycled PID can't get an innocent process killed.
+        if not router_reaped:
+            for pid in info.get("pids", []):
+                try:
+                    with open(f"/proc/{pid}/cmdline", "rb") as f:
+                        if b"etcd_tpu" not in f.read():
+                            continue
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
