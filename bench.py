@@ -528,10 +528,20 @@ def child_main() -> int:
             G_e = int(os.environ.get("BENCH_ENGINE_GROUPS",
                                      min(G, 100_000 if on_tpu else 2048)))
         E = 4
+        # Applier pool width (engine.EngineConfig.applier_shards): the
+        # post-commit apply/ack path partitioned by tenant range across
+        # K worker threads. Default 2: the measured sweet spot of the
+        # K in {1,2,4} sweep (docs/perf.md) — 1.96x deep-queue over the
+        # single applier even on a 1-core box (appliers overlap the
+        # round loop's WAL fsync stalls), while K=4 only adds scheduling
+        # overhead until there are cores to back it. Set 1 for the
+        # single-applier baseline.
+        K_appl = int(os.environ.get("BENCH_APPLIER_SHARDS", 2))
         with tempfile.TemporaryDirectory() as tmp:
             eng = MultiEngine(EngineConfig(
                 groups=G_e, peers=P, data_dir=tmp, window=16, max_ents=E,
                 heartbeat_tick=3, fsync=True, stagger=True,
+                applier_shards=K_appl,
                 checkpoint_rounds=1 << 30))
             def all_led():
                 # Vectorized: leader_slot() per group is an O(G) Python
@@ -656,6 +666,7 @@ def child_main() -> int:
             # engine).
             DEEP = 64
             deep_aps = rd = None
+            deep_samples = []
             if (label == "engine" and G_e * DEEP <= 2_000_000
                     and time.time() < sc_deadline - 5.0):
                 deep_end = time.time() + 0.3 * (sc_deadline - time.time())
@@ -663,7 +674,11 @@ def child_main() -> int:
                 t_d = time.time()
                 rd = 0
                 while time.time() < deep_end - 0.5 or rd < 5:
-                    offer(rd, depth=DEEP, sample=False)
+                    # One fresh-id waiter per round rides the depth-64
+                    # backlog: deep_queue_p50/p99 report what a request
+                    # actually waits behind a saturated pipeline (the
+                    # throughput-vs-latency price of queue depth).
+                    offer(rd, depth=DEEP)
                     eng.run_round()
                     rd += 1
                     if rd >= 100000:
@@ -672,6 +687,7 @@ def child_main() -> int:
                 deep_acked = eng.acked_requests - d0
                 drain()
                 deep_aps = deep_acked / deep_elapsed
+                deep_samples, samples = samples, []
 
             # -- Phase B: latency AT LOAD — offered load paced to ~50% of
             # the measured saturated capacity (the standard way to report
@@ -702,6 +718,12 @@ def child_main() -> int:
             for _ in range(6):
                 eng.run_round()
             eng._drain_applies()
+            # Per-shard apply share BEFORE stop tears the workers down:
+            # phase_s has one "apply" key at K=1, "apply[k]" per worker
+            # otherwise (each written by exactly one thread).
+            apply_s = {k: v for k, v in eng.phase_s.items()
+                       if k == "apply" or k.startswith("apply[")}
+            n_shards = len(eng._appliers)
             eng.stop()
         # Discard phase-B warmup (first 20% of the window): the paced rate
         # needs a few rounds to reach steady state.
@@ -717,18 +739,37 @@ def child_main() -> int:
                 if s_lats else None)
         sp99 = (round(1000 * float(np.percentile(s_lats, 99)), 3)
                 if s_lats else None)
+        d_lats = [s.t1 - s.t0 for s in deep_samples if s.t1 is not None]
+        dp50 = (round(1000 * float(np.percentile(d_lats, 50)), 3)
+                if d_lats else None)
+        dp99 = (round(1000 * float(np.percentile(d_lats, 99)), 3)
+                if d_lats else None)
+        # Per-shard apply share: each worker's fraction of the pool's
+        # total apply seconds — flags range-imbalance (a hot shard shows
+        # up here long before it throttles the round loop).
+        tot_apply = sum(apply_s.values())
+        shard_share = ({k: round(v / tot_apply, 3)
+                        for k, v in sorted(apply_s.items())}
+                       if tot_apply > 0 else {})
         deep_txt = (f"deep-queue (depth {DEEP}) {deep_aps:,.0f} writes/s "
-                    f"over {rd} rounds; " if deep_aps is not None else "")
-        log(f"[{label}] G={G_e} P={P}: {acked} acked writes in "
+                    f"over {rd} rounds (p50 {dp50} p99 {dp99} ms); "
+                    if deep_aps is not None else "")
+        log(f"[{label}] G={G_e} P={P} applier_shards={n_shards}: "
+            f"{acked} acked writes in "
             f"{elapsed:.2f}s / {r} rounds -> {aps:,.0f} writes/s "
             f"(fsync on, depth {E}); {deep_txt}ack latency at "
             f"50% load p50 {p50} p99 {p99} ms over {len(b_lats)} samples "
-            f"({rb} paced rounds); saturated p50 {sp50} p99 {sp99} ms")
+            f"({rb} paced rounds); saturated p50 {sp50} p99 {sp99} ms; "
+            f"apply share {shard_share}")
         deep_keys = ({"deep_queue_acked_writes_per_sec": round(deep_aps, 1),
                       "deep_queue_depth": DEEP,
-                      "deep_queue_rounds": rd}
+                      "deep_queue_rounds": rd,
+                      "deep_queue_p50_ms": dp50,
+                      "deep_queue_p99_ms": dp99}
                      if deep_aps is not None else {})
         return {"acked_writes_per_sec": round(aps, 1),
+                "applier_shards": n_shards,
+                "apply_share_per_shard": shard_share,
                 "commits_per_sec": round(aps, 1),
                 **deep_keys,
                 "groups": G_e,
@@ -758,9 +799,26 @@ def child_main() -> int:
     # 7-peer geometry is a second cold compile).
     order = (["uniform", "engine", "latency", "zipf", "lag", "churn"]
              if sel == "all" else [sel])
+    results = {}
+    if (sel == "all" and not on_tpu
+            and "BENCH_LAT_GROUPS" not in os.environ):
+        # On CPU the latency scenario collapses into the engine scenario
+        # (same G=2048, same paced 50%-load phase B) — re-measuring it
+        # burned ~22% of a CPU bench run for a duplicate number. Skip it
+        # with a marker and let the other scenarios inherit its share;
+        # BENCH_LAT_GROUPS (or selecting `latency` directly) still runs
+        # it, and TPU runs keep the 12,500 per-chip shard shape.
+        order.remove("latency")
+        results["latency"] = {
+            "skipped": "cpu-duplicate-of-engine-shape",
+            "note": "engine scenario at the same G already reports the "
+                    "50%-load p50/p99; set BENCH_LAT_GROUPS or run "
+                    "`latency` directly to force a distinct shape"}
     remaining = deadline - time.time()
     shares = ([_WEIGHTS[sc] for sc in order] if len(order) > 1
               else [1.0])
+    # Reallocate a dropped scenario's share instead of idling it.
+    shares = [s / sum(shares) for s in shares]
 
     def emit(results):
         """Print the CUMULATIVE result line after every scenario: if a
@@ -787,7 +845,6 @@ def child_main() -> int:
         }
         print(json.dumps(out), flush=True)
 
-    results = {}
     for i, (sc, share) in enumerate(zip(order, shares)):
         if i > 0 and time.time() > deadline - 5.0:
             log(f"budget exhausted; skipping scenarios {order[i:]}")

@@ -185,6 +185,9 @@ _FLAGS = [
     ("engine-mesh-peers-axis", int, 0,
      "Shard the engine over all visible devices: mesh peers-axis size "
      "(0 = no mesh, 1 = all devices on the groups axis)"),
+    ("engine-applier-shards", int, 1,
+     "Applier pool size: partition the post-commit apply/ack path by "
+     "tenant range across N worker threads (1 = single applier)"),
 ]
 
 
@@ -281,6 +284,8 @@ def parse_args(argv: Sequence[str],
             raise ConfigError("-engine-interval-ms must be >= 0")
         if cfg.engine_mesh_peers_axis < 0:
             raise ConfigError("-engine-mesh-peers-axis must be >= 0")
+        if cfg.engine_applier_shards < 1:
+            raise ConfigError("-engine-applier-shards must be >= 1")
     if 5 * cfg.heartbeat_interval > cfg.election_timeout:
         raise ConfigError(
             f"-election-timeout[{cfg.election_timeout}ms] should be at least "

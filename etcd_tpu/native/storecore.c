@@ -23,6 +23,7 @@
 #define _GNU_SOURCE /* memrchr */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <pythread.h>
 #include <math.h>
 #include <stdint.h>
 #include <string.h>
@@ -372,9 +373,56 @@ typedef struct {
     Py_ssize_t heap_len, heap_cap;
     long long stats[NSTATS];
     PyObject *namespaces; /* tuple of str: write-protected top-level dirs */
+    /* C copies of `namespaces` so the readonly check runs without the
+     * GIL (set_many's batch phase). Immutable after construction. */
+    char **ns_c;
+    Py_ssize_t *ns_len;
+    Py_ssize_t ns_n;
     RingRec *ring;        /* circular event history */
     Py_ssize_t ring_cap, ring_len, ring_head; /* head = oldest */
+    /* Serializes tree/heap/ring/stats access against set_many's
+     * GIL-RELEASED batch phase: every Python-visible entry point takes
+     * it (core_lock), so a reader on an HTTP thread never walks a tree
+     * mid-mutation. Before the batch phase existed the GIL alone made
+     * every entry atomic; the mutex restores that guarantee per Core
+     * while letting K applier shards mutate DISJOINT cores in
+     * parallel. */
+    PyThread_type_lock mux;
 } CoreObject;
+
+static void
+core_lock(CoreObject *c)
+{
+    /* Uncontended fast path: one atomic try, no GIL churn. On
+     * contention, RELEASE THE GIL before blocking: the holder may be
+     * set_many's batch phase, whose descriptor-building tail must
+     * reacquire the GIL while still holding the mutex — a thread
+     * waiting on the mutex WITH the GIL would deadlock it. Invariant:
+     * no thread ever blocks on the mutex while holding the GIL. */
+    if (PyThread_acquire_lock(c->mux, NOWAIT_LOCK))
+        return;
+    Py_BEGIN_ALLOW_THREADS
+    PyThread_acquire_lock(c->mux, WAIT_LOCK);
+    Py_END_ALLOW_THREADS
+}
+
+#define core_unlock(c) PyThread_release_lock((c)->mux)
+
+/* Locked trampoline: METH_NOARGS handlers share the same C signature
+ * (second arg NULL), so one shape covers the whole method table. While
+ * the mutex is held the body may still run Python code (tuple builds,
+ * EtcdError construction) and the GIL may switch threads — any thread
+ * that then enters THIS core parks on the mutex with the GIL released
+ * (core_lock), so progress is never lost. */
+#define LOCKED(name) \
+static PyObject * \
+name##_L(CoreObject *c, PyObject *args) \
+{ \
+    core_lock(c); \
+    PyObject *r = name(c, args); \
+    core_unlock(c); \
+    return r; \
+}
 
 static int
 ring_push(CoreObject *c, int action, PyObject *nd, PyObject *pd,
@@ -545,12 +593,14 @@ notfound:
     return NULL;
 }
 
-/* Walk to dirname creating missing dirs at `index` (reference walk with
- * checkDir; store.py _make_dirs): an existing FILE on the path raises 104
- * NOT_DIR with the file's path as cause. */
+/* Walk to dirname creating missing dirs at `index`. GIL-FREE variant
+ * (set_many's batch phase): on failure returns NULL with *ecode set to
+ * ECODE_NOT_DIR (cause = the blocking file's path, stable for the
+ * batch: set_many never detaches nodes) or -1 for OOM. */
 static CNode *
-core_make_dirs(CoreObject *c, const char *path, Py_ssize_t len,
-               uint64_t index)
+core_make_dirs_c(CoreObject *c, const char *path, Py_ssize_t len,
+                 uint64_t index, int *ecode, const char **cause,
+                 Py_ssize_t *clen)
 {
     CNode *cur = c->root;
     Py_ssize_t i = 0;
@@ -569,12 +619,13 @@ core_make_dirs(CoreObject *c, const char *path, Py_ssize_t len,
             if (nxt == NULL || cmap_add(cur->children, nxt) < 0) {
                 if (nxt)
                     node_decref(nxt);
-                PyErr_NoMemory();
+                *ecode = -1;
                 return NULL;
             }
         } else if (nxt->children == NULL) {
-            raise_etcd(ECODE_NOT_DIR, nxt->path, nxt->path_len,
-                       c->current_index);
+            *ecode = ECODE_NOT_DIR;
+            *cause = nxt->path;
+            *clen = nxt->path_len;
             return NULL;
         }
         cur = nxt;
@@ -583,21 +634,36 @@ core_make_dirs(CoreObject *c, const char *path, Py_ssize_t len,
     return cur;
 }
 
+/* GIL-holding wrapper (reference walk with checkDir; store.py
+ * _make_dirs): an existing FILE on the path raises 104 NOT_DIR with the
+ * file's path as cause. */
+static CNode *
+core_make_dirs(CoreObject *c, const char *path, Py_ssize_t len,
+               uint64_t index)
+{
+    int ecode = 0;
+    const char *cause = NULL;
+    Py_ssize_t clen = 0;
+    CNode *n = core_make_dirs_c(c, path, len, index, &ecode, &cause,
+                                &clen);
+    if (n == NULL) {
+        if (ecode == -1)
+            PyErr_NoMemory();
+        else
+            raise_etcd(ecode, cause, clen, c->current_index);
+    }
+    return n;
+}
+
+/* GIL-free (reads only the C namespace copies built at construction). */
 static int
-core_is_readonly(CoreObject *c, const char *path, Py_ssize_t len)
+core_is_readonly(const CoreObject *c, const char *path, Py_ssize_t len)
 {
     if (len == 1 && path[0] == '/')
         return 1;
-    if (c->namespaces != NULL) {
-        Py_ssize_t n = PyTuple_GET_SIZE(c->namespaces);
-        for (Py_ssize_t i = 0; i < n; i++) {
-            Py_ssize_t nl;
-            const char *ns = PyUnicode_AsUTF8AndSize(
-                PyTuple_GET_ITEM(c->namespaces, i), &nl);
-            if (ns != NULL && nl == len && memcmp(ns, path, len) == 0)
-                return 1;
-        }
-    }
+    for (Py_ssize_t i = 0; i < c->ns_n; i++)
+        if (c->ns_len[i] == len && memcmp(c->ns_c[i], path, len) == 0)
+            return 1;
     return 0;
 }
 
@@ -823,98 +889,315 @@ Core_set(CoreObject *c, PyObject *args)
     return result3(nd, pd, next);
 }
 
-/* Batched plain-file SETs for the engine apply loop (one GIL-atomic call
- * per log-entry batch instead of one per request): paths/values are equal
- * -length lists of str, no TTL, no dirs. Per-op etcd errors (e.g. set
- * over a dir) fail THAT op exactly as the scalar call would — stats
- * counted, index unmoved — and the batch continues; only fatal errors
- * (OOM, a non-str item) abort. CONTRACT on a fatal abort: ops before the
- * failing one HAVE been applied and current_index HAS advanced, and the
- * exception does not say how far — so the caller must treat the
- * exception as fatal to the apply loop and HALT (the engine applier
- * fail-stops and re-raises, server/engine.py _applier_loop; recovery is
- * WAL replay, which re-applies the span deterministically). Continuing
- * past it would diverge replicas on a nondeterministic failure (e.g.
- * OOM on one member only), where the scalar path fails one request
- * atomically. History ring records are produced per applied op, so
- * watch scans and the facade's not-quiet re-notify see every event.
- * Returns (first_index, last_index, n_failed, recs) — recs is a list of
- * per-applied-op (nd, pd|None, index) when want_recs is true (so a
- * watcher fan-out can notify without rescanning the ring — a batch
- * larger than the ring capacity evicts its own earliest records), else
- * None. first > last when nothing applied. */
+/* Per-op scratch for set_many's three phases. */
+typedef struct {
+    const char *path, *value;   /* borrowed from the arg lists (alive) */
+    Py_ssize_t plen, vlen;
+    uint64_t idx;               /* applied index; 0 = this op failed */
+    char *pv;                   /* malloc'd copy of the prev value */
+    Py_ssize_t pvlen;
+    uint64_t pcr, pmo;          /* prev created/modified */
+    double pex;                 /* prev expire (NAN = permanent) */
+    uint8_t had_prev, need;
+    int code;                   /* etcd error code when idx == 0 */
+    const char *cause;          /* error cause (stable for the batch) */
+    Py_ssize_t clen;
+    uint64_t eidx;              /* current_index at failure time */
+} SetOp;
+
+/* Build a 6-tuple desc from captured fields (same shape as node_desc).
+ * A plain-file SET's nd is fully derivable from its inputs
+ * (created = modified = idx, no TTL), so the batch phase never has to
+ * hold node pointers across later ops that may overwrite them. */
+static PyObject *
+desc_from(const char *key, Py_ssize_t klen, const char *val,
+          Py_ssize_t vlen, uint64_t created, uint64_t modified,
+          double expire)
+{
+    PyObject *ex;
+    if (isnan(expire)) {
+        ex = Py_None;
+        Py_INCREF(ex);
+    } else {
+        ex = PyFloat_FromDouble(expire);
+        if (ex == NULL)
+            return NULL;
+    }
+    PyObject *t = Py_BuildValue("(s#s#OKKO)", key, klen, val, vlen,
+                                Py_False, (unsigned long long)created,
+                                (unsigned long long)modified, ex);
+    Py_DECREF(ex);
+    return t;
+}
+
+/* Batched plain-file SETs for the engine apply loop: paths/values are
+ * equal-length lists of str, no TTL, no dirs. Runs in three phases:
+ *   1. GIL held: parse every path/value/need item up front (a non-str
+ *      item fails the whole batch BEFORE any mutation).
+ *   2. GIL RELEASED, per-core mutex held: the pure-C mutation loop.
+ *      This is the phase that lets K applier shards (disjoint tenant
+ *      cores) apply in true parallel on a multi-core box.
+ *   3. GIL reacquired, mutex STILL held: build desc tuples and ring
+ *      records for the applied prefix — holding the mutex through the
+ *      history tail means no reader ever observes current_index
+ *      advanced ahead of the ring (a watch registering mid-batch would
+ *      otherwise scan past events that "already happened").
+ * Per-op etcd errors (e.g. set over a dir) fail THAT op exactly as the
+ * scalar call would — stats counted, index unmoved — and the batch
+ * continues; only fatal errors (OOM, a non-str item) abort. CONTRACT
+ * on a fatal abort: ops before the failing one HAVE been applied and
+ * current_index HAS advanced, and the exception does not say how far —
+ * the caller must treat it as fatal to the apply loop and HALT (the
+ * engine applier fail-stops and re-raises, server/engine.py
+ * _applier_loop; recovery is WAL replay, which re-applies the span
+ * deterministically). Continuing past it would diverge replicas on a
+ * nondeterministic failure (e.g. OOM on one member only).
+ * Returns (first_index, last_index, n_failed, recs, descs):
+ *   recs  — [(nd, pd|None, index)] per applied op when want_recs (so a
+ *           watcher fan-out can notify without rescanning the ring),
+ *           else None.
+ *   descs — when `need` (a sequence of op positions) is given, one
+ *           entry per requested position: (pos, nd, pd|None, index) for
+ *           an applied op, (pos, None, (code, cause), index_at_failure)
+ *           for a per-op etcd failure. This is the descriptor-based
+ *           waiter wake: the applier hands these raw C descriptors to
+ *           the wait registry and the HTTP thread materializes the
+ *           Event/JSON. None when `need` is None.
+ * first > last when nothing applied. */
 static PyObject *
 Core_set_many(CoreObject *c, PyObject *args)
 {
-    PyObject *paths, *vals;
+    PyObject *paths, *vals, *need_o = Py_None;
     double now;
     int want_recs = 0;
-    if (!PyArg_ParseTuple(args, "O!O!d|p", &PyList_Type, &paths,
-                          &PyList_Type, &vals, &now, &want_recs))
+    if (!PyArg_ParseTuple(args, "O!O!d|pO", &PyList_Type, &paths,
+                          &PyList_Type, &vals, &now, &want_recs, &need_o))
         return NULL;
     Py_ssize_t n = PyList_GET_SIZE(paths);
     if (PyList_GET_SIZE(vals) != n) {
         PyErr_SetString(PyExc_ValueError, "paths/values length mismatch");
         return NULL;
     }
-    PyObject *recs = NULL;
-    if (want_recs) {
-        recs = PyList_New(0);
-        if (recs == NULL)
-            return NULL;
-    }
-    uint64_t first = c->current_index + 1;
-    Py_ssize_t failed = 0;
+    SetOp *ops = (SetOp *)calloc(n ? n : 1, sizeof(SetOp));
+    if (ops == NULL)
+        return PyErr_NoMemory();
+    /* -- phase 1 (GIL): parse everything up front */
     for (Py_ssize_t i = 0; i < n; i++) {
-        Py_ssize_t plen, vlen;
-        const char *path = PyUnicode_AsUTF8AndSize(
-            PyList_GET_ITEM(paths, i), &plen);
-        if (path == NULL) {
-            Py_XDECREF(recs);
+        ops[i].path = PyUnicode_AsUTF8AndSize(PyList_GET_ITEM(paths, i),
+                                              &ops[i].plen);
+        ops[i].value = PyUnicode_AsUTF8AndSize(PyList_GET_ITEM(vals, i),
+                                               &ops[i].vlen);
+        if (ops[i].path == NULL || ops[i].value == NULL) {
+            free(ops);
             return NULL;
         }
-        const char *value = PyUnicode_AsUTF8AndSize(
-            PyList_GET_ITEM(vals, i), &vlen);
-        if (value == NULL) {
-            Py_XDECREF(recs);
+    }
+    if (need_o != Py_None) {
+        PyObject *seq = PySequence_Fast(need_o, "need must be a sequence");
+        if (seq == NULL) {
+            free(ops);
             return NULL;
         }
-        PyObject *nd, *pd;
-        uint64_t idx = set_apply(c, path, plen, value, vlen, 0, NAN, now,
-                                 &nd, &pd);
-        if (idx == 0) {
-            if (!PyErr_GivenExceptionMatches(PyErr_Occurred(), EtcdError)) {
-                Py_XDECREF(recs);
-                return NULL;       /* fatal (OOM etc.): abort the batch */
+        Py_ssize_t m = PySequence_Fast_GET_SIZE(seq);
+        for (Py_ssize_t i = 0; i < m; i++) {
+            Py_ssize_t pos = PyLong_AsSsize_t(
+                PySequence_Fast_GET_ITEM(seq, i));
+            if (pos == -1 && PyErr_Occurred()) {
+                Py_DECREF(seq);
+                free(ops);
+                return NULL;
             }
-            PyErr_Clear();
+            if (pos < 0 || pos >= n) {
+                Py_DECREF(seq);
+                free(ops);
+                PyErr_SetString(PyExc_IndexError,
+                                "need position out of range");
+                return NULL;
+            }
+            ops[pos].need = 1;
+        }
+        Py_DECREF(seq);
+    }
+    uint64_t first = 0;
+    Py_ssize_t failed = 0;
+    Py_ssize_t fatal = -1;  /* op index where an OOM abort hit */
+    /* -- phase 2 (no GIL, mutex held): pure-C mutations */
+    Py_BEGIN_ALLOW_THREADS
+    PyThread_acquire_lock(c->mux, WAIT_LOCK);
+    first = c->current_index + 1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        SetOp *op = &ops[i];
+        if (core_is_readonly(c, op->path, op->plen)) {
+            c->stats[ST_SETS_FAIL]++;
+            op->code = ECODE_ROOT_RONLY;
+            op->cause = "/";
+            op->clen = 1;
+            op->eidx = c->current_index;
             failed++;
             continue;
         }
-        if (recs != NULL) {
-            PyObject *rec = Py_BuildValue(
-                "(OOK)", nd, pd == NULL ? Py_None : pd,
-                (unsigned long long)idx);
-            if (rec == NULL || PyList_Append(recs, rec) < 0) {
-                Py_XDECREF(rec);
-                Py_DECREF(nd);
-                Py_XDECREF(pd);
-                Py_DECREF(recs);
-                return NULL;
+        uint64_t next = c->current_index + 1;
+        Py_ssize_t dlen, nlen;
+        const char *name;
+        split_dirname(op->path, op->plen, &dlen, &name, &nlen);
+        int ecode = 0;
+        const char *cz = NULL;
+        Py_ssize_t cl = 0;
+        CNode *parent = core_make_dirs_c(c, op->path, dlen, next, &ecode,
+                                         &cz, &cl);
+        if (parent == NULL) {
+            c->stats[ST_SETS_FAIL]++;
+            if (ecode == -1) {
+                fatal = i;
+                break;
             }
-            Py_DECREF(rec);
+            op->code = ecode;
+            op->cause = cz;
+            op->clen = cl;
+            op->eidx = c->current_index;
+            failed++;
+            continue;
         }
-        Py_DECREF(nd);
-        Py_XDECREF(pd);
+        CNode *existing = cmap_get(parent->children, name,
+                                   (uint32_t)nlen);
+        if (existing != NULL && existing->children != NULL) {
+            /* set over a dir: 102 */
+            c->stats[ST_SETS_FAIL]++;
+            op->code = ECODE_NOT_FILE;
+            op->cause = op->path;
+            op->clen = op->plen;
+            op->eidx = c->current_index;
+            failed++;
+            continue;
+        }
+        if (existing != NULL) {
+            /* snapshot prev BEFORE the in-place overwrite (the desc
+             * tuple is built in phase 3, under the GIL) */
+            op->pv = (char *)malloc(existing->value_len + 1);
+            if (op->pv == NULL) {
+                fatal = i;
+                break;
+            }
+            memcpy(op->pv, existing->value, existing->value_len + 1);
+            op->pvlen = existing->value_len;
+            op->pcr = existing->created;
+            op->pmo = existing->modified;
+            op->pex = existing->expire;
+            op->had_prev = 1;
+            if (node_set_value(existing, op->value, op->vlen) < 0) {
+                fatal = i;
+                break;
+            }
+            /* a SET is a brand-new node: both indices move; a stale
+             * TTL-heap entry invalidates lazily (heap_top) */
+            existing->created = existing->modified = next;
+            existing->expire = NAN;
+        } else {
+            CNode *nn = node_new(op->path, (uint32_t)op->plen, next, next,
+                                 parent, op->value, op->vlen, 0, NAN);
+            if (nn == NULL || cmap_add(parent->children, nn) < 0) {
+                if (nn)
+                    node_decref(nn);
+                fatal = i;
+                break;
+            }
+        }
+        /* no heap_push: set_many never carries a TTL */
+        c->current_index = next;
+        c->stats[ST_SETS_OK]++;
+        op->idx = next;
     }
-    if (recs == NULL) {
-        recs = Py_None;
-        Py_INCREF(recs);
+    Py_END_ALLOW_THREADS
+    /* -- phase 3 (GIL + mutex): descs/recs/ring for the applied prefix */
+    PyObject *recs = NULL, *descs = NULL, *ret = NULL;
+    if (want_recs) {
+        recs = PyList_New(0);
+        if (recs == NULL)
+            goto done;
     }
-    PyObject *out = Py_BuildValue("(KKnN)", (unsigned long long)first,
-                                  (unsigned long long)c->current_index,
-                                  failed, recs);
-    return out;
+    if (need_o != Py_None) {
+        descs = PyList_New(0);
+        if (descs == NULL)
+            goto done;
+    }
+    {
+        Py_ssize_t lim = fatal >= 0 ? fatal : n;
+        for (Py_ssize_t i = 0; i < lim; i++) {
+            SetOp *op = &ops[i];
+            if (op->idx == 0) {
+                if (op->need) {
+                    PyObject *d = Py_BuildValue(
+                        "(nO(is#)K)", i, Py_None, op->code, op->cause,
+                        op->clen, (unsigned long long)op->eidx);
+                    if (d == NULL || PyList_Append(descs, d) < 0) {
+                        Py_XDECREF(d);
+                        goto done;
+                    }
+                    Py_DECREF(d);
+                }
+                continue;
+            }
+            if (!op->need && recs == NULL && c->ring_cap == 0)
+                continue;
+            PyObject *nd = desc_from(op->path, op->plen, op->value,
+                                     op->vlen, op->idx, op->idx, NAN);
+            if (nd == NULL)
+                goto done;
+            PyObject *pd = NULL;
+            if (op->had_prev) {
+                pd = desc_from(op->path, op->plen, op->pv, op->pvlen,
+                               op->pcr, op->pmo, op->pex);
+                if (pd == NULL) {
+                    Py_DECREF(nd);
+                    goto done;
+                }
+            }
+            ring_push(c, ACT_SET, nd, pd, op->idx, now);
+            if (recs != NULL) {
+                PyObject *rec = Py_BuildValue(
+                    "(OOK)", nd, pd == NULL ? Py_None : pd,
+                    (unsigned long long)op->idx);
+                if (rec == NULL || PyList_Append(recs, rec) < 0) {
+                    Py_XDECREF(rec);
+                    Py_DECREF(nd);
+                    Py_XDECREF(pd);
+                    goto done;
+                }
+                Py_DECREF(rec);
+            }
+            if (op->need) {
+                PyObject *d = Py_BuildValue(
+                    "(nOOK)", i, nd, pd == NULL ? Py_None : pd,
+                    (unsigned long long)op->idx);
+                if (d == NULL || PyList_Append(descs, d) < 0) {
+                    Py_XDECREF(d);
+                    Py_DECREF(nd);
+                    Py_XDECREF(pd);
+                    goto done;
+                }
+                Py_DECREF(d);
+            }
+            Py_DECREF(nd);
+            Py_XDECREF(pd);
+        }
+    }
+    if (fatal >= 0) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    ret = Py_BuildValue(
+        "(KKnOO)", (unsigned long long)first,
+        (unsigned long long)c->current_index, failed,
+        recs == NULL ? Py_None : recs,
+        descs == NULL ? Py_None : descs);
+done:
+    core_unlock(c);
+    Py_XDECREF(recs);
+    Py_XDECREF(descs);
+    for (Py_ssize_t i = 0; i < n; i++)
+        free(ops[i].pv);
+    free(ops);
+    return ret;
 }
 
 /* ------------------------------------------------------------ create op */
@@ -1740,7 +2023,10 @@ Core_set_stats(CoreObject *c, PyObject *args)
 static PyObject *
 Core_get_index(CoreObject *c, void *closure)
 {
-    return PyLong_FromUnsignedLongLong(c->current_index);
+    core_lock(c);
+    PyObject *r = PyLong_FromUnsignedLongLong(c->current_index);
+    core_unlock(c);
+    return r;
 }
 
 static int
@@ -1749,9 +2035,31 @@ Core_set_index(CoreObject *c, PyObject *v, void *closure)
     unsigned long long x = PyLong_AsUnsignedLongLong(v);
     if (x == (unsigned long long)-1 && PyErr_Occurred())
         return -1;
+    core_lock(c);
     c->current_index = x;
+    core_unlock(c);
     return 0;
 }
+
+/* Locked entry points (see core_lock): everything that touches the
+ * tree/heap/ring/stats must exclude set_many's GIL-free batch phase.
+ * set_many itself manages the mutex around its phases. */
+LOCKED(Core_set)
+LOCKED(Core_create)
+LOCKED(Core_update)
+LOCKED(Core_cas)
+LOCKED(Core_cad)
+LOCKED(Core_delete)
+LOCKED(Core_expire_keys)
+LOCKED(Core_next_expiration)
+LOCKED(Core_scan)
+LOCKED(Core_ring_bounds)
+LOCKED(Core_get)
+LOCKED(Core_dump)
+LOCKED(Core_load)
+LOCKED(Core_clone)
+LOCKED(Core_stats)
+LOCKED(Core_set_stats)
 
 /* --------------------------------------------------------- construction */
 
@@ -1767,6 +2075,11 @@ Core_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
     CoreObject *c = (CoreObject *)type->tp_alloc(type, 0);
     if (c == NULL)
         return NULL;
+    c->mux = PyThread_allocate_lock();
+    if (c->mux == NULL) {
+        Py_DECREF(c);
+        return PyErr_NoMemory();
+    }
     if (capacity > 0) {
         c->ring = (RingRec *)calloc(capacity, sizeof(RingRec));
         if (c->ring == NULL) {
@@ -1785,6 +2098,13 @@ Core_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
         Py_INCREF(namespaces);
         c->namespaces = namespaces;
         Py_ssize_t n = PyTuple_GET_SIZE(namespaces);
+        /* C copies so the readonly check runs GIL-free (set_many) */
+        c->ns_c = (char **)calloc(n ? n : 1, sizeof(char *));
+        c->ns_len = (Py_ssize_t *)calloc(n ? n : 1, sizeof(Py_ssize_t));
+        if (c->ns_c == NULL || c->ns_len == NULL) {
+            Py_DECREF(c);
+            return PyErr_NoMemory();
+        }
         for (Py_ssize_t i = 0; i < n; i++) {
             Py_ssize_t nl;
             const char *ns = PyUnicode_AsUTF8AndSize(
@@ -1793,6 +2113,14 @@ Core_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
                 Py_DECREF(c);
                 return NULL;
             }
+            c->ns_c[i] = (char *)malloc(nl + 1);
+            if (c->ns_c[i] == NULL) {
+                Py_DECREF(c);
+                return PyErr_NoMemory();
+            }
+            memcpy(c->ns_c[i], ns, nl + 1);
+            c->ns_len[i] = nl;
+            c->ns_n = i + 1;
             CNode *nn = node_new(ns, (uint32_t)nl, 0, 0, c->root, NULL, 0,
                                  1, NAN);
             if (nn == NULL || cmap_add(c->root->children, nn) < 0) {
@@ -1843,44 +2171,53 @@ Core_dealloc(CoreObject *c)
     if (c->root != NULL)
         node_decref(c->root);
     Py_XDECREF(c->namespaces);
+    for (Py_ssize_t i = 0; i < c->ns_n; i++)
+        free(c->ns_c[i]);
+    free(c->ns_c);
+    free(c->ns_len);
+    if (c->mux != NULL)
+        PyThread_free_lock(c->mux);
     Py_TYPE(c)->tp_free((PyObject *)c);
 }
 
 static PyMethodDef Core_methods[] = {
-    {"set", (PyCFunction)Core_set, METH_VARARGS,
+    {"set", (PyCFunction)Core_set_L, METH_VARARGS,
      "set(path, is_dir, value, expire) -> (desc, prev|None, index)"},
     {"set_many", (PyCFunction)Core_set_many, METH_VARARGS,
-     "set_many(paths, values, now, want_recs=False) -> (first_index, "
-     "last_index, n_failed, recs|None); batched plain-file SETs, per-op "
-     "etcd errors skipped; recs = [(nd, pd|None, index)] when asked"},
-    {"create", (PyCFunction)Core_create, METH_VARARGS,
+     "set_many(paths, values, now, want_recs=False, need=None) -> "
+     "(first_index, last_index, n_failed, recs|None, descs|None); "
+     "batched plain-file SETs (mutations run with the GIL released "
+     "under the per-core mutex), per-op etcd errors skipped; recs = "
+     "[(nd, pd|None, index)] when asked; descs = raw descriptors for "
+     "the `need` op positions (see the function comment)"},
+    {"create", (PyCFunction)Core_create_L, METH_VARARGS,
      "create(path, is_dir, value, expire) -> (desc, None, index)"},
-    {"update", (PyCFunction)Core_update, METH_VARARGS,
+    {"update", (PyCFunction)Core_update_L, METH_VARARGS,
      "update(path, value, refresh, expire) -> (desc, prev, index)"},
-    {"cas", (PyCFunction)Core_cas, METH_VARARGS,
+    {"cas", (PyCFunction)Core_cas_L, METH_VARARGS,
      "cas(path, prev_value, prev_index, value, expire)"},
-    {"cad", (PyCFunction)Core_cad, METH_VARARGS,
+    {"cad", (PyCFunction)Core_cad_L, METH_VARARGS,
      "cad(path, prev_value, prev_index)"},
-    {"delete", (PyCFunction)Core_delete, METH_VARARGS,
+    {"delete", (PyCFunction)Core_delete_L, METH_VARARGS,
      "delete(path, is_dir, recursive, want_paths)"
      " -> ((desc, prev, index), removed|None)"},
-    {"expire_keys", (PyCFunction)Core_expire_keys, METH_VARARGS,
+    {"expire_keys", (PyCFunction)Core_expire_keys_L, METH_VARARGS,
      "expire_keys(cutoff) -> [(desc, prev, removed, index)]"},
-    {"next_expiration", (PyCFunction)Core_next_expiration, METH_NOARGS,
+    {"next_expiration", (PyCFunction)Core_next_expiration_L, METH_NOARGS,
      "earliest live expiry or None"},
-    {"scan", (PyCFunction)Core_scan, METH_VARARGS,
+    {"scan", (PyCFunction)Core_scan_L, METH_VARARGS,
      "scan(key, recursive, since) -> (action, nd, pd, index, now)|None"},
-    {"ring_bounds", (PyCFunction)Core_ring_bounds, METH_NOARGS,
+    {"ring_bounds", (PyCFunction)Core_ring_bounds_L, METH_NOARGS,
      "(start_index, last_index, len) of the history ring"},
-    {"get", (PyCFunction)Core_get, METH_VARARGS,
+    {"get", (PyCFunction)Core_get_L, METH_VARARGS,
      "get(path, recursive, sorted) -> 7-tuple tree"},
-    {"dump", (PyCFunction)Core_dump, METH_NOARGS,
+    {"dump", (PyCFunction)Core_dump_L, METH_NOARGS,
      "full tree as 7-tuples (snapshot shape)"},
-    {"load", (PyCFunction)Core_load, METH_VARARGS,
+    {"load", (PyCFunction)Core_load_L, METH_VARARGS,
      "replace tree from dump() shape"},
-    {"clone", (PyCFunction)Core_clone, METH_NOARGS, "deep copy"},
-    {"stats", (PyCFunction)Core_stats, METH_NOARGS, "counter tuple"},
-    {"set_stats", (PyCFunction)Core_set_stats, METH_VARARGS,
+    {"clone", (PyCFunction)Core_clone_L, METH_NOARGS, "deep copy"},
+    {"stats", (PyCFunction)Core_stats_L, METH_NOARGS, "counter tuple"},
+    {"set_stats", (PyCFunction)Core_set_stats_L, METH_VARARGS,
      "replace counters"},
     {NULL}
 };

@@ -37,7 +37,32 @@ streams, same draw points (reference raft.go:765-771 semantics).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
+
+
+def _donate_at_import(argnums):
+    """donate_argnums for the module-level jitted steps, decided at
+    IMPORT time: XLA:CPU has a donated-buffer race (see "CPU donation
+    hazard" below), so when the process has already pinned a non-TPU
+    platform via JAX_PLATFORMS (the test suite, ./test, CI, Procfile
+    all export cpu) the decorators skip donation entirely — this is
+    what keeps kernel-direct tests (and the whole shared pytest heap)
+    safe. When JAX_PLATFORMS is unset the platform isn't knowable
+    without initializing the backend (illegal at import: multihost
+    scripts set distributed state after importing this module), so the
+    decorators keep donation and serving engines re-decide per live
+    backend via step_variant()/donate_safe(). ETCD_TPU_DONATE=on|off
+    overrides both layers."""
+    mode = os.environ.get("ETCD_TPU_DONATE", "auto")
+    if mode in ("on", "1"):
+        return tuple(argnums)
+    if mode in ("off", "0"):
+        return ()
+    plats = os.environ.get("JAX_PLATFORMS", "").lower()
+    if plats and "tpu" not in plats and "axon" not in plats:
+        return ()
+    return tuple(argnums)
 
 import jax
 import jax.numpy as jnp
@@ -646,7 +671,7 @@ def _assemble_sends(st: GroupState, cfg: KernelConfig, resp: jax.Array,
 # The step
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=_donate_at_import((1,)))
 def step(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
          prop_count: jax.Array, prop_slot: jax.Array, tick: jax.Array
          ) -> Tuple[GroupState, jax.Array]:
@@ -869,7 +894,7 @@ def _step_body(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     return st, outbox
 
 
-@functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=_donate_at_import((1, 2)))
 def step_routed_auto(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
                      prop_count: jax.Array, prop_slot: jax.Array,
                      tick: jax.Array, drop_mask=None,
@@ -928,7 +953,7 @@ CHG_RING = 4     # any ring (log-term window) slot changed
 CHG_STATE = 8    # role changed (host mirror only; never journaled)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=_donate_at_import((1, 2)))
 def step_routed_compact(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
                         prop_count: jax.Array, prop_slot: jax.Array,
                         tick: jax.Array, drop_mask=None, hops: int = 1
@@ -984,7 +1009,7 @@ def gather_rows(st: GroupState, gi: jax.Array, pi: jax.Array):
             st.log_term[gi, pi])
 
 
-@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=_donate_at_import((1, 2)))
 def step_routed_slots(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
                       cnt_gp: jax.Array, tick: jax.Array
                       ) -> Tuple[GroupState, jax.Array]:
@@ -998,7 +1023,7 @@ def step_routed_slots(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     return st, route_local(outbox)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 6), donate_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 6), donate_argnums=_donate_at_import((1, 2)))
 def step_routed_slots_auto(cfg: KernelConfig, st: GroupState,
                            inbox: jax.Array, cnt_gp: jax.Array,
                            tick: jax.Array, drop_mask=None,
@@ -1020,7 +1045,7 @@ def step_routed_slots_auto(cfg: KernelConfig, st: GroupState,
                                         tick, drop_mask, hops)
 
 
-@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=_donate_at_import((1, 2)))
 def step_routed(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
                 prop_count: jax.Array, prop_slot: jax.Array,
                 tick: jax.Array) -> Tuple[GroupState, jax.Array]:
@@ -1030,3 +1055,67 @@ def step_routed(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
     st, outbox = step.__wrapped__(cfg, st, inbox, prop_count, prop_slot,
                                   tick)
     return st, route_local(outbox)
+
+
+# ---------------------------------------------------------------------------
+# CPU donation hazard
+# ---------------------------------------------------------------------------
+# XLA:CPU's thunk executor has a buffer-aliasing race under the donated
+# multi-hop step: a donated input that an output merely passes through
+# (peer_mask — the kernel never writes it, so XLA aliases input buffer
+# to output) occasionally comes back holding a DIFFERENT intermediate of
+# the same program (the step's is-leader mask). Bisected at G=4/P=5:
+# 21/40 boots corrupted with donation, 0/40 without, with bit-identical
+# trajectories both ways — a runtime race, not a miscompile. The same
+# race scribbles freed heap: long engine workloads segfault or hang at
+# shutdown ~1/3 of runs with donation and never without (12/12 clean).
+# Two gates keep cpu runs off donation: the module-level jits import
+# undonated whenever JAX_PLATFORMS pins a non-TPU platform
+# (_donate_at_import — covers the test suite and every kernel-direct
+# caller), and serving engines re-decide per LIVE backend below (covers
+# the JAX_PLATFORMS-unset cpu fallback). TPU keeps donation — the state
+# arrays ARE the HBM budget there, and the race has only ever been
+# observed on cpu. The engine's
+# peer_mask watchdog (EngineConfig.mask_check_rounds) stays on as
+# defense-in-depth for donating backends. ETCD_TPU_DONATE=on|off
+# overrides the auto choice (e.g. `on` to A/B the race, `off` to run a
+# TPU box conservatively).
+
+_STEP_STATICS = {
+    "step_routed_auto": (0, 7),
+    "step_routed_compact": (0, 7),
+    "step_routed_slots_auto": (0, 6),
+}
+
+
+def donate_safe(argnums):
+    """`argnums` if donation is safe on the LIVE backend, else ().
+
+    Calls jax.default_backend(), which initializes the backend — only
+    call this from engine/serving init (platform flags final), never at
+    import time (multihost scripts set JAX_PLATFORMS/distributed state
+    after importing this module)."""
+    mode = os.environ.get("ETCD_TPU_DONATE", "auto")
+    if mode in ("on", "1"):
+        return tuple(argnums)
+    if mode in ("off", "0"):
+        return ()
+    return () if jax.default_backend() == "cpu" else tuple(argnums)
+
+
+@functools.lru_cache(maxsize=None)
+def _undonated(name):
+    return jax.jit(globals()[name].__wrapped__,
+                   static_argnums=_STEP_STATICS[name])
+
+
+def step_variant(name):
+    """The module-level jitted step `name`, or its undonated twin when
+    donation is unsafe on the live backend (cached — one compile per
+    shape either way). When the module jits already imported undonated
+    (_donate_at_import, e.g. the JAX_PLATFORMS=cpu test suite) the
+    module jit IS the undonated twin — reuse it so kernel-direct tests
+    and engine tests share one compile cache."""
+    if donate_safe((1,)) or not _donate_at_import((1,)):
+        return globals()[name]
+    return _undonated(name)

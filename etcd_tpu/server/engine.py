@@ -61,6 +61,7 @@ from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, METHOD_QGET, METHOD_SYNC,
                                      Request)
 from etcd_tpu.store import new_store
+from etcd_tpu.store.event import LazyWriteEvent
 from etcd_tpu.utils import idutil
 from etcd_tpu.utils.wait import Wait
 
@@ -176,7 +177,18 @@ class EngineConfig:
     # Backpressure: how many rounds of committed-but-unapplied work may
     # queue at the applier before the round loop blocks. Bounds ack
     # latency at ~(this+1) x apply-time-per-round under saturation.
+    # With applier_shards > 1 this bounds the DEEPEST shard's backlog,
+    # not the sum — one hot shard cannot borrow the others' budget.
     apply_queue_rounds: int = 2
+    # Compartmentalized applier pool (PAPERS.md "Scaling Replicated
+    # State Machines with Compartmentalization"): partition each round's
+    # committed-entry view by tenant range into this many shards, each
+    # applied+acked by its own worker thread. storecore.c releases the
+    # GIL around batched mutations and every shard owns a disjoint set
+    # of tenant stores, so K workers make real parallel progress on a
+    # multi-core box while per-group apply order stays FIFO (a group
+    # lives in exactly one shard). 1 = today's single-applier behavior.
+    applier_shards: int = 1
     # Message hops chained inside ONE kernel invocation (both the
     # single-device and the mesh path). 3 = propose -> replicate ->
     # commit completes within the round it was staged, cutting ack
@@ -197,6 +209,56 @@ class EngineConfig:
     # Max changed+staged rows served by the gather path before a round
     # falls back to full readback. 0 = auto: max(2048, G*P//8).
     compact_cap: int = 0
+    # Liveness watchdog cadence (rounds): every N rounds verify the
+    # DEVICE peer_mask still equals the host h_mask and repair it from
+    # the host copy if not. Membership only ever flows host -> device
+    # (_apply_conf / _restore surgery), so any divergence is device
+    # buffer corruption — observed on the CPU backend under the donated
+    # multi-hop step, where the mask buffer occasionally comes back
+    # holding the step's is-leader intermediate. A corrupt mask is a
+    # PERMANENT wedge (it silences every cross-slot send and suppresses
+    # campaigns, and feeds the next round's donated step), so the check
+    # is on by default; it costs one (G, P) bool readback per N rounds.
+    # The root cause is gated at source — cpu engines run an UNDONATED
+    # step (kernel.py "CPU donation hazard") — so on cpu this is pure
+    # defense-in-depth (repairs only fire with ETCD_TPU_DONATE=on);
+    # donating backends keep the safety net. 0 disables.
+    mask_check_rounds: int = 64
+
+
+class _AckCounter:
+    """Mutable ack tally. _apply_committed increments whichever tally it
+    is handed — a shard worker's own, or the engine's synchronous-path
+    one — so the counters need no locking (one writer each) and
+    MultiEngine.acked_requests sums them."""
+
+    __slots__ = ("acked",)
+
+    def __init__(self) -> None:
+        self.acked = 0
+
+
+class _ApplierShard:
+    """One compartment of the applier pool: a worker thread owning the
+    contiguous tenant range [g_lo, g_hi), with its own commit-view
+    queue, its own backpressure/condition variable, and its own ack
+    tally. Shards share no mutable state except disjoint slices of
+    engine.applied and disjoint tenant stores, so K workers drive K
+    GIL-releasing storecore batch applies in true parallel."""
+
+    __slots__ = ("idx", "g_lo", "g_hi", "cv", "q", "stop", "exc",
+                 "thread", "acct")
+
+    def __init__(self, idx: int, g_lo: int, g_hi: int) -> None:
+        self.idx = idx
+        self.g_lo = g_lo
+        self.g_hi = g_hi
+        self.cv = threading.Condition()
+        self.q: deque = deque()
+        self.stop = False
+        self.exc: Optional[Exception] = None
+        self.thread: Optional[threading.Thread] = None
+        self.acct = _AckCounter()
 
 
 class MultiEngine:
@@ -242,7 +304,7 @@ class MultiEngine:
             _mesh_step = jax.jit(
                 functools.partial(kernel.step_routed_auto.__wrapped__,
                                   self.kcfg, hops=cfg.hops),
-                donate_argnums=(0, 1),
+                donate_argnums=kernel.donate_safe((0, 1)),
                 out_shardings=(self._st_sh, self._mb_sh))
             self._step_fn = (
                 lambda st, inbox, pc, ps, t: _mesh_step(
@@ -255,8 +317,12 @@ class MultiEngine:
             # cfg.hops chains propose->replicate->commit inside the one
             # program (see kernel.step_routed_auto); the drop mask rides
             # into the kernel so fault injection cuts EVERY hop.
+            # step_variant: undonated twin on the cpu backend — XLA:CPU
+            # has a donated-buffer race (see kernel.py "CPU donation
+            # hazard"); donation stays on TPU.
+            _auto = kernel.step_variant("step_routed_auto")
             self._step_fn = (
-                lambda st, inbox, pc, ps, t: kernel.step_routed_auto(
+                lambda st, inbox, pc, ps, t: _auto(
                     self.kcfg, st, inbox, pc, ps, t, self.drop_mask,
                     self.cfg.hops))
         self._compact = (cfg.compact_readback if cfg.compact_readback
@@ -271,8 +337,13 @@ class MultiEngine:
         # would never see the surgery — the next round must take the
         # full-readback path to re-sync mirrors and journal it.
         self._force_full = False
+        # Count of peer_mask watchdog repairs (EngineConfig.
+        # mask_check_rounds); >0 means the device mask diverged from the
+        # host's and was restored.
+        self.mask_repairs = 0
+        _compact_step = kernel.step_variant("step_routed_compact")
         self._step_fn_c = (
-            lambda st, inbox, pc, ps, t: kernel.step_routed_compact(
+            lambda st, inbox, pc, ps, t: _compact_step(
                 self.kcfg, st, inbox, pc, ps, t, self.drop_mask,
                 self.cfg.hops))
 
@@ -302,15 +373,23 @@ class MultiEngine:
         # Last few durable round records, kept for the violation dump.
         self._recent_recs: deque = deque(maxlen=8)
         self.failed: Optional[Exception] = None
-        # Applier thread state (cfg.pipeline_applies): committed spans are
-        # handed off as immutable views and applied+acked concurrently
-        # with the next rounds' device steps and WAL fsyncs (both of which
-        # release the GIL, so the applier makes real progress under them).
-        self._apply_cv = threading.Condition()
-        self._apply_q: deque = deque()
-        self._apply_stop = False
-        self._apply_exc: Optional[Exception] = None
-        self._apply_thread: Optional[threading.Thread] = None
+        # Applier pool (cfg.pipeline_applies): committed spans are handed
+        # off as immutable views and applied+acked concurrently with the
+        # next rounds' device steps and WAL fsyncs (both of which release
+        # the GIL, so the appliers make real progress under them). With
+        # applier_shards=K the tenant pool is partitioned into K
+        # contiguous ranges — shard k owns [k*ceil(G/K), ...), the same
+        # convention scripts/pool_serve.py uses — each applied by its own
+        # worker. Empty tail shards (K not dividing G) get no thread.
+        K = max(1, min(cfg.applier_shards, G))
+        per = -(-G // K)
+        self._appliers = [
+            _ApplierShard(k, min(k * per, G), min((k + 1) * per, G))
+            for k in range(K)]
+        self._appliers = [sh for sh in self._appliers if sh.g_lo < sh.g_hi]
+        # Acks from synchronous applies (conf rounds, pipeline off,
+        # restore); shard workers tally into their own counters.
+        self._acks = _AckCounter()
         self._last_sync_scan = 0.0
         # g -> redeadline for the one in-flight SYNC allowed per tenant.
         self._sync_pending: Dict[int, float] = {}
@@ -335,10 +414,6 @@ class MultiEngine:
         self.h_ring = np.zeros((G, P, W), np.int32)
         self.h_mask = np.zeros((G, P), bool)
         self.applied = np.zeros(G, np.int64)
-        # Client REQUESTS acked in LIVE rounds (not entries: a batched
-        # entry carries many; restart replay does not count). The
-        # serving-throughput counter — meters measure deltas.
-        self.acked_requests = 0
         self.payloads: Dict[Tuple[int, int, int], bytes] = {}
         # Live-path sidecar of self.payloads: the already-decoded Requests
         # of an admitted entry, so the apply loop skips re-parsing JSON it
@@ -607,16 +682,27 @@ class MultiEngine:
                 self._drain_applies()
             except Exception as e:  # noqa: BLE001 — applier's deferred error
                 self.failed = e
-        with self._apply_cv:
-            self._apply_stop = True
-            self._apply_cv.notify_all()
-        if self._apply_thread is not None:
-            self._apply_thread.join(timeout=10)
+        for sh in self._appliers:
+            with sh.cv:
+                sh.stop = True
+                sh.cv.notify_all()
+        for sh in self._appliers:
+            if sh.thread is not None:
+                sh.thread.join(timeout=10)
         self.wal.close()
 
     # ------------------------------------------------------------------
-    # applier thread (cfg.pipeline_applies)
+    # applier pool (cfg.pipeline_applies, cfg.applier_shards)
     # ------------------------------------------------------------------
+
+    @property
+    def acked_requests(self) -> int:
+        """Client REQUESTS acked in LIVE rounds (not entries: a batched
+        entry carries many; restart replay does not count). The
+        serving-throughput counter — meters measure deltas. Summed across
+        the synchronous path and every applier shard's own tally."""
+        return self._acks.acked + sum(sh.acct.acked
+                                      for sh in self._appliers)
 
     def _commit_view(self) -> tuple:
         """Immutable snapshot of what the applier needs from this round's
@@ -627,72 +713,95 @@ class MultiEngine:
         c = np.where(self.h_mask, self.h_commit, 0)
         return c.max(axis=1), c.argmax(axis=1), self.h_ring, self.h_last
 
-    def _ensure_applier(self) -> None:
-        t = self._apply_thread
-        if t is None or not t.is_alive():
-            self._apply_stop = False
-            self._apply_thread = threading.Thread(
-                target=self._applier_loop, daemon=True,
-                name="engine-applier")
-            self._apply_thread.start()
+    def _ensure_appliers(self) -> None:
+        for sh in self._appliers:
+            t = sh.thread
+            if t is None or not t.is_alive():
+                if sh.exc is not None:
+                    # The worker HALTed mid-span; respawning would
+                    # re-apply (and re-ack) the queued view from the
+                    # top. Stay down — the seam re-raises.
+                    continue
+                sh.stop = False
+                sh.thread = threading.Thread(
+                    target=self._applier_loop, args=(sh,), daemon=True,
+                    name=f"engine-applier-{sh.idx}")
+                sh.thread.start()
 
-    def _applier_loop(self) -> None:
+    def _applier_loop(self, sh: _ApplierShard) -> None:
+        # Phase key: "apply" for the single-shard pool (keeps profiles
+        # comparable with pre-pool captures), "apply[k]" per worker
+        # otherwise — each key has exactly one writer thread.
+        pkey = "apply" if len(self._appliers) == 1 else f"apply[{sh.idx}]"
         while True:
-            with self._apply_cv:
-                while not self._apply_q and not self._apply_stop:
-                    self._apply_cv.wait(0.2)
-                if not self._apply_q:
+            with sh.cv:
+                while not sh.q and not sh.stop:
+                    sh.cv.wait(0.2)
+                if not sh.q:
                     return           # stop requested and queue drained
-                view = self._apply_q[0]   # stays queued while in progress
+                view = sh.q[0]       # stays queued while in progress
             t0 = time.perf_counter()
             try:
-                self._apply_committed(trigger=True, view=view)
+                self._apply_committed(trigger=True, view=view,
+                                      g_lo=sh.g_lo, g_hi=sh.g_hi,
+                                      acct=sh.acct)
             except Exception as e:  # noqa: BLE001 — re-raised at the seam
-                log.exception("engine applier failed")
-                with self._apply_cv:
-                    self._apply_exc = e
-                    self._apply_cv.notify_all()
+                log.exception("engine applier shard %d failed", sh.idx)
+                with sh.cv:
+                    sh.exc = e
+                    sh.cv.notify_all()
                 # HALT — consuming further views after a mid-span failure
                 # would re-apply and re-ack around the hole. The engine
                 # fail-stops at the next enqueue/drain, which re-raises.
                 return
-            self.phase_s["apply"] = self.phase_s.get("apply", 0.0) + \
+            self.phase_s[pkey] = self.phase_s.get(pkey, 0.0) + \
                 (time.perf_counter() - t0)
-            with self._apply_cv:
-                self._apply_q.popleft()
-                self._apply_cv.notify_all()
+            with sh.cv:
+                sh.q.popleft()
+                sh.cv.notify_all()
 
     def _enqueue_apply(self, view: tuple) -> None:
-        """Hand one round's committed work to the applier, blocking while
-        the backlog is at the cap (bounds ack latency under saturation)."""
-        self._ensure_applier()
-        with self._apply_cv:
-            while (len(self._apply_q) >= self.cfg.apply_queue_rounds
-                   and self._apply_exc is None):
-                self._apply_cv.wait(0.5)
-            self._apply_q.append(view)
-            self._apply_cv.notify_all()
+        """Hand one round's committed work to every applier shard,
+        blocking while the DEEPEST shard's backlog is at the cap (bounds
+        ack latency under saturation; a sum-bound would let one hot
+        shard spend the other shards' latency budget)."""
+        self._ensure_appliers()
+        for sh in self._appliers:
+            with sh.cv:
+                while (len(sh.q) >= self.cfg.apply_queue_rounds
+                       and sh.exc is None):
+                    sh.cv.wait(0.5)
+                sh.q.append(view)
+                sh.cv.notify_all()
         self._raise_apply_exc()
 
     def _drain_applies(self) -> None:
-        """Block until every queued apply finished; then surface any
-        applier error. All synchronous seams (conf changes, checkpoints,
-        admin surgery, stop) come through here before touching state the
-        applier also owns (stores, applied, payload GC)."""
-        if self._apply_thread is not None:
-            with self._apply_cv:
-                while (self._apply_q and self._apply_exc is None
-                       and self._apply_thread.is_alive()):
-                    self._apply_cv.notify_all()
-                    self._apply_cv.wait(0.5)
+        """Block until every queued apply on every shard finished; then
+        surface any applier error. All synchronous seams (conf changes,
+        checkpoints, admin surgery, stop) come through here before
+        touching state the appliers also own (stores, applied, payload
+        GC)."""
+        for sh in self._appliers:
+            if sh.thread is not None:
+                with sh.cv:
+                    while (sh.q and sh.exc is None
+                           and sh.thread.is_alive()):
+                        sh.cv.notify_all()
+                        sh.cv.wait(0.5)
         self._raise_apply_exc()
-        if self._apply_q and not self._apply_thread.is_alive():
-            raise RuntimeError("applier thread died with work queued")
+        for sh in self._appliers:
+            if sh.q and (sh.thread is None or not sh.thread.is_alive()):
+                raise RuntimeError(
+                    f"applier shard {sh.idx} died with work queued")
 
     def _raise_apply_exc(self) -> None:
-        if self._apply_exc is not None:
-            e, self._apply_exc = self._apply_exc, None
-            raise e
+        # sh.exc stays set: a HALTed shard is terminally failed (its
+        # worker never respawns — see _ensure_appliers), so EVERY later
+        # seam re-raises rather than letting one caller absorb the
+        # error and the next one sail past a dead compartment.
+        for sh in self._appliers:
+            if sh.exc is not None:
+                raise sh.exc
 
     def store(self, g: int):
         s = self._stores.get(g)
@@ -764,6 +873,11 @@ class MultiEngine:
                                    index=int(self.applied[g]))
         if isinstance(result, errors.EtcdError):
             raise result
+        if type(result) is LazyWriteEvent:
+            # The ack/waiter stage woke us with raw C descriptors; the
+            # Event/NodeExtern churn happens HERE, on the serving thread,
+            # off the (serialized) apply stage.
+            return result.resolve()
         return result
 
     def conf_change(self, g: int, op: str, slot: int,
@@ -1282,6 +1396,9 @@ class MultiEngine:
 
         ph["tail"] = ph.get("tail", 0.0) + (time.perf_counter() - t_ph)
         self.round_no += 1
+        if (self.cfg.mask_check_rounds
+                and self.round_no % self.cfg.mask_check_rounds == 0):
+            self._check_mask()
         ms = (time.perf_counter() - t_round) * 1000.0
         if self.round_ms_ewma == 0.0:
             self.round_ms_ewma = ms      # seed with the first sample
@@ -1455,21 +1572,29 @@ class MultiEngine:
                     out.append((int(g), d["slot"], op))
         return out
 
-    def _apply_committed(self, trigger: bool, hist=None,
-                         view=None) -> None:
+    def _apply_committed(self, trigger: bool, hist=None, view=None,
+                         g_lo: int = 0, g_hi: Optional[int] = None,
+                         acct: Optional[_AckCounter] = None) -> None:
         """Apply every newly committed entry (applied..commit per group)
         to its tenant store and trigger waiters. `view` is an immutable
-        (gc, s_vec, ring, last) snapshot when called from the applier
-        thread; None applies against the live mirrors (synchronous
-        callers + replay)."""
+        (gc, s_vec, ring, last) snapshot when called from an applier
+        worker; None applies against the live mirrors (synchronous
+        callers + replay). [g_lo, g_hi) restricts the pass to one
+        shard's tenant range (workers touch only their own slice of
+        self.applied and their own stores); acct is the ack tally to
+        charge — the worker's own, or the engine's synchronous one."""
         W = self.cfg.window
+        if acct is None:
+            acct = self._acks
         if view is None:
             gc, s_vec, h_ring, h_last = self._commit_view()
         else:
             gc, s_vec, h_ring, h_last = view
-        changed = np.nonzero(gc > self.applied)[0]
+        if g_hi is None:
+            g_hi = len(gc)
+        changed = np.nonzero(gc[g_lo:g_hi] > self.applied[g_lo:g_hi])[0]
         for g in changed:
-            g = int(g)
+            g = int(g) + g_lo
             s, lo, hi = int(s_vec[g]), int(self.applied[g]), int(gc[g])
             ring_row = h_ring[g, s]
             last_gs = int(h_last[g, s])
@@ -1515,49 +1640,56 @@ class MultiEngine:
                             reqs = [Request.decode(b)
                                     for b in _unpack_multi(payload)]
                     # Batched fast path: runs of plain-file PUTs with no
-                    # conditions, no TTL, and no waiter holding the id
-                    # apply through ONE GIL-atomic C call per run
+                    # conditions and no TTL apply through ONE
+                    # GIL-releasing C call per run
                     # (NativeStore.set_applied_many) instead of a full
                     # Python dispatch per request — the apply loop's
-                    # throughput ceiling at scale. A request that needs a
-                    # result (waiter), carries conditions/TTL, or isn't a
-                    # PUT flushes the run and applies through the scalar
-                    # path, preserving log order exactly. Runs never span
-                    # log entries (the per-entry cursor advance below must
-                    # stay exact). Fast-path requests are client writes
-                    # (SYNC never qualifies: its method is not PUT); their
-                    # per-op store errors count as served, same as a
-                    # scalar error result nobody was waiting for.
-                    many = getattr(self.store(g), "set_applied_many", None)
+                    # throughput ceiling at scale. Waiter-held plain PUTs
+                    # ride the batch too: their positions go in `need`,
+                    # the C call returns raw node descriptors for them,
+                    # and the waiter is woken with a LazyWriteEvent (the
+                    # Event/JSON churn happens on the HTTP thread that
+                    # resolves it, not here — the ack/waiter stage of the
+                    # compartmentalized path). A request that carries
+                    # conditions/TTL or isn't a plain PUT flushes the run
+                    # and applies through the scalar path, preserving log
+                    # order exactly. Runs never span log entries (the
+                    # per-entry cursor advance below must stay exact).
+                    # Fast-path requests are client writes (SYNC never
+                    # qualifies: its method is not PUT); their per-op
+                    # store errors count as served, same as a scalar
+                    # error result.
+                    st = self.store(g)
+                    many = getattr(st, "set_applied_many", None)
                     is_reg = self.wait.is_registered
-                    fp, fv = [], []
+                    fp, fv, fneed, frids = [], [], [], []
                     for r in reqs:
                         if (many is not None and r.method == METHOD_PUT
                                 and not r.dir and not r.refresh
                                 and r.prev_exist is None
                                 and not r.prev_index and not r.prev_value
-                                and r.expiration is None
-                                and not is_reg(r.id)):
+                                and r.expiration is None):
+                            if is_reg(r.id):
+                                fneed.append(len(fp))
+                                frids.append(r.id)
                             fp.append(r.path)
                             fv.append(r.val or "")
                             continue
                         if fp:
-                            many(fp, fv)
-                            if trigger:
-                                self.acked_requests += len(fp)
-                            fp, fv = [], []
+                            self._flush_many(st, fp, fv, fneed, frids,
+                                             trigger, acct)
+                            fp, fv, fneed, frids = [], [], [], []
                         try:
                             result = self._apply_request(g, r)
                         except errors.EtcdError as err:
                             result = err
                         if trigger:
                             if r.method != METHOD_SYNC:
-                                self.acked_requests += 1
+                                acct.acked += 1
                             self.wait.trigger(r.id, result)
                     if fp:
-                        many(fp, fv)
-                        if trigger:
-                            self.acked_requests += len(fp)
+                        self._flush_many(st, fp, fv, fneed, frids,
+                                         trigger, acct)
                 elif payload[0] == P_CONF:
                     d = json.loads(payload[1:].decode())
                     self._apply_conf(g, d["op"], d["slot"])
@@ -1571,6 +1703,31 @@ class MultiEngine:
                 # (duplicate watch events / double store mutations).
                 self.applied[g] = i
             self.applied[g] = hi
+
+    def _flush_many(self, st, fp: list, fv: list, fneed: list,
+                    frids: list, trigger: bool, acct: _AckCounter) -> None:
+        """Apply one batched run of plain-file PUTs. Positions listed in
+        fneed hold waiters: the C call returns their raw node
+        descriptors, and each waiter is woken with a LazyWriteEvent (or
+        the per-op EtcdError) — Event materialization is deferred to the
+        HTTP thread that resolves it in do()."""
+        if not fneed:
+            st.set_applied_many(fp, fv)
+            if trigger:
+                acct.acked += len(fp)
+            return
+        now = st.clock()
+        _, descs = st.set_applied_many(fp, fv, need=fneed)
+        if trigger:
+            acct.acked += len(fp)
+            for (pos, nd, pd, idx), rid in zip(descs, frids):
+                if nd is None:
+                    code, cause = pd
+                    res: Any = errors.EtcdError(code, cause=cause,
+                                                index=idx)
+                else:
+                    res = LazyWriteEvent(nd, pd, idx, now)
+                self.wait.trigger(rid, res)
 
     def _apply_request(self, g: int, r: Request):
         """Deterministic request->store mapping (reference applyRequest
@@ -1597,9 +1754,15 @@ class MultiEngine:
             if not r.dir:
                 # Unconditional file PUT — the apply loop's dominant op.
                 # The native store skips Event materialization entirely
-                # unless a waiter holds this id or a watcher is live.
-                return st.set_applied(r.path, r.val, exp,
-                                      self.wait.is_registered(r.id))
+                # unless a watcher is live; a waiter-held id gets the raw
+                # descriptors (LazyWriteEvent) and the HTTP thread that
+                # consumes the result materializes the Event in do().
+                if self.wait.is_registered(r.id):
+                    lazy = getattr(st, "set_applied_lazy", None)
+                    if lazy is not None:
+                        return lazy(r.path, r.val, exp)
+                    return st.set_applied(r.path, r.val, exp, True)
+                return st.set_applied(r.path, r.val, exp, False)
             return st.set(r.path, is_dir=r.dir, value=r.val, expire_time=exp)
         if r.method == METHOD_DELETE:
             if r.prev_index or r.prev_value:
@@ -1618,6 +1781,33 @@ class MultiEngine:
     # ------------------------------------------------------------------
     # host surgery: conf changes + snapshot install
     # ------------------------------------------------------------------
+
+    def _check_mask(self) -> None:
+        """Liveness watchdog (EngineConfig.mask_check_rounds): the device
+        peer_mask must ALWAYS equal the host h_mask — membership flows
+        only host -> device through _apply_conf/_restore, in the round
+        thread, with h_mask written first. Any divergence is therefore
+        device buffer corruption. Observed mode (CPU backend, donated
+        multi-hop step; disabling donation makes it vanish): the mask
+        buffer comes back holding the step's is-leader intermediate —
+        one active slot per group — which silences every cross-slot send
+        AND suppresses campaigns, a permanent wedge since the corrupt
+        value feeds the next round's donated step. Repair from the host
+        copy (a fresh buffer: jnp.asarray of a live numpy array may be
+        zero-copy, and the repaired mask enters the donated chain);
+        recovery then needs no further help — the next tick's heartbeat
+        timeout resumes the leader's paused probes and replication
+        catches up."""
+        m = np.asarray(self.st.peer_mask)
+        if np.array_equal(m, self.h_mask):
+            return
+        self.mask_repairs += 1
+        bad = int((m != self.h_mask).any(axis=1).sum())
+        log.warning("device peer_mask diverged from host mask in %d "
+                    "group(s) at round %d (repair #%d) — restoring",
+                    bad, self.round_no, self.mask_repairs)
+        self.st = self.st._replace(
+            peer_mask=self._dev("peer_mask", self.h_mask.copy()))
 
     def _apply_conf(self, g: int, op: str, slot: int,
                     admin: bool = False) -> None:

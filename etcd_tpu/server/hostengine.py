@@ -65,6 +65,7 @@ from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, METHOD_QGET, METHOD_SYNC,
                                      Request)
 from etcd_tpu.store import new_store
+from etcd_tpu.store.event import LazyWriteEvent
 from etcd_tpu.utils import idutil
 from etcd_tpu.utils.wait import Wait
 
@@ -173,7 +174,7 @@ class HostEngine:
             self._step_fn = jax.jit(
                 functools.partial(kernel.step_routed_slots_auto.__wrapped__,
                                   self.kcfg, hops=1),
-                donate_argnums=(0, 1))
+                donate_argnums=kernel.donate_safe((0, 1)))
             # Per-sender queues of sparse mailbox frames (bounded: a
             # slower host drops OLDEST — raft retransmits; reference
             # drop-on-full, peer.go:156-165) + our own self-loop slice.
@@ -198,7 +199,7 @@ class HostEngine:
             self._step_fn = jax.jit(
                 functools.partial(kernel.step_routed_slots_auto.__wrapped__,
                                   self.kcfg, hops=cfg.hops),
-                donate_argnums=(0, 1),
+                donate_argnums=kernel.donate_safe((0, 1)),
                 out_shardings=(self._st_sh, self._mb_sh))
 
         self._check_geometry()
@@ -855,6 +856,10 @@ class HostEngine:
                                    index=int(self.applied[g]))
         if isinstance(result, errors.EtcdError):
             raise result
+        if type(result) is LazyWriteEvent:
+            # Waiter woken with raw C descriptors: materialize the Event
+            # here on the serving thread (see MultiEngine.do).
+            return result.resolve()
         return result
 
     # ------------------------------------------------------------------
@@ -1203,9 +1208,12 @@ class HostEngine:
                     # hosts apply the same entries purely for state — so
                     # runs of unconditional PUTs collapse into one
                     # GIL-atomic C call per run.
-                    many = getattr(self.store(g), "set_applied_many", None)
+                    st = self.store(g)
+                    many = getattr(st, "set_applied_many", None)
                     fp: List[str] = []
                     fv: List[str] = []
+                    fneed: List[int] = []
+                    frids: List[int] = []
                     is_reg = self.wait.is_registered
                     for blob in _unpack_multi(payload):
                         r = Request.decode(blob)
@@ -1213,16 +1221,21 @@ class HostEngine:
                                 and not r.dir and not r.refresh
                                 and r.prev_exist is None
                                 and not r.prev_index and not r.prev_value
-                                and r.expiration is None
-                                and not is_reg(r.id)):
+                                and r.expiration is None):
+                            if is_reg(r.id):
+                                # Locally-proposed waiter-held PUTs ride
+                                # the batch: the waiter is woken with the
+                                # raw descriptors (LazyWriteEvent; see
+                                # MultiEngine._flush_many).
+                                fneed.append(len(fp))
+                                frids.append(r.id)
                             fp.append(r.path)
                             fv.append(r.val or "")
                             continue
                         if fp:
-                            many(fp, fv)
-                            if trigger:
-                                self.acked_requests += len(fp)
-                            fp, fv = [], []
+                            self._flush_many(st, fp, fv, fneed, frids,
+                                             trigger)
+                            fp, fv, fneed, frids = [], [], [], []
                         try:
                             result = self._apply_request(g, r)
                         except errors.EtcdError as err:
@@ -1232,9 +1245,8 @@ class HostEngine:
                                 self.acked_requests += 1
                             self.wait.trigger(r.id, result)
                     if fp:
-                        many(fp, fv)
-                        if trigger:
-                            self.acked_requests += len(fp)
+                        self._flush_many(st, fp, fv, fneed, frids,
+                                         trigger)
                 done = i
             self.applied[g] = done
             if self._hist:
@@ -1261,6 +1273,29 @@ class HostEngine:
             prev_t = self._hist.get((g, i - 1), 0)
         return prev_t != 0 and prev_t < t
 
+    def _flush_many(self, st, fp: List[str], fv: List[str],
+                    fneed: List[int], frids: List[int],
+                    trigger: bool) -> None:
+        """One batched run of plain-file PUTs; need-listed waiters are
+        woken with raw descriptors (see MultiEngine._flush_many)."""
+        if not fneed:
+            st.set_applied_many(fp, fv)
+            if trigger:
+                self.acked_requests += len(fp)
+            return
+        now = st.clock()
+        _, descs = st.set_applied_many(fp, fv, need=fneed)
+        if trigger:
+            self.acked_requests += len(fp)
+            for (pos, nd, pd, idx), rid in zip(descs, frids):
+                if nd is None:
+                    code, cause = pd
+                    res: Any = errors.EtcdError(code, cause=cause,
+                                                index=idx)
+                else:
+                    res = LazyWriteEvent(nd, pd, idx, now)
+                self.wait.trigger(rid, res)
+
     def _apply_request(self, g: int, r: Request):
         st = self.store(g)
         exp = r.expiration
@@ -1283,8 +1318,12 @@ class HostEngine:
                                            r.prev_index, r.val, exp)
             if not r.dir:
                 # see engine._apply_request: lazy-event fast path
-                return st.set_applied(r.path, r.val, exp,
-                                      self.wait.is_registered(r.id))
+                if self.wait.is_registered(r.id):
+                    lazy = getattr(st, "set_applied_lazy", None)
+                    if lazy is not None:
+                        return lazy(r.path, r.val, exp)
+                    return st.set_applied(r.path, r.val, exp, True)
+                return st.set_applied(r.path, r.val, exp, False)
             return st.set(r.path, is_dir=r.dir, value=r.val, expire_time=exp)
         if r.method == METHOD_DELETE:
             if r.prev_index or r.prev_value:

@@ -41,6 +41,7 @@ from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      Request)
 from etcd_tpu.server.stats import LeaderStats, ServerStats
 from etcd_tpu.server.storage import ServerStorage, read_wal
+from etcd_tpu.store.event import LazyWriteEvent
 from etcd_tpu.server.transport import Transporter
 from etcd_tpu.snap import Snapshotter
 from etcd_tpu.store import new_store
@@ -447,6 +448,10 @@ class EtcdServer:
                 (time.perf_counter() - t0) * 1e3)
             if isinstance(result, errors.EtcdError):
                 raise result
+            if type(result) is LazyWriteEvent:
+                # The apply loop woke us with raw C descriptors; build
+                # the Event here, off the run-loop thread.
+                return result.resolve()
             return result
         raise errors.EtcdError(errors.ECODE_INVALID_FORM,
                                cause=f"bad method {r.method}")
@@ -861,6 +866,17 @@ class EtcdServer:
                     mid, d.get("name", ""), d.get("clientURLs", ()))
                 if mid == self.id:
                     self._published = True
+                return st.set(r.path, is_dir=r.dir, value=r.val,
+                              expire_time=exp)
+            if not r.dir and self.wait.is_registered(r.id):
+                # Unconditional file PUT with a live waiter: hand back
+                # raw descriptors and let the serving thread materialize
+                # the Event (do()), keeping the run-loop thread's apply
+                # slice minimal. Falls through for stores without the
+                # native lazy path.
+                lazy = getattr(st, "set_applied_lazy", None)
+                if lazy is not None:
+                    return lazy(r.path, r.val, exp)
             return st.set(r.path, is_dir=r.dir, value=r.val, expire_time=exp)
         if r.method == METHOD_DELETE:
             if r.prev_index or r.prev_value:

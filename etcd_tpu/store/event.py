@@ -86,6 +86,36 @@ class Event:
         return d
 
 
+class LazyWriteEvent:
+    """Raw C write descriptors standing in for a materialized Event on the
+    applier → waiter handoff. The applier records only the descriptor
+    6-tuples the native store already built; the HTTP thread that consumes
+    the waiter's result calls resolve() to pay for the NodeExtern/Event
+    churn — moving ~40% of the per-ack Python work off the (serialized)
+    apply stage onto the (parallel) serving threads. Only plain-file SETs
+    take this path, so `action` is fixed."""
+
+    __slots__ = ("nd", "pd", "etcd_index", "now")
+    action = SET
+
+    def __init__(self, nd, pd, etcd_index: int, now: float) -> None:
+        self.nd = nd
+        self.pd = pd
+        self.etcd_index = etcd_index
+        self.now = now
+
+    def _extern(self, d) -> NodeExtern:
+        key, value, is_dir, created, modified, exp = d
+        return NodeExtern(key, value, is_dir, None, created, modified, exp,
+                          ttl_of(exp, self.now))
+
+    def resolve(self) -> Event:
+        return Event(SET, node=self._extern(self.nd),
+                     prev_node=(None if self.pd is None
+                                else self._extern(self.pd)),
+                     etcd_index=self.etcd_index)
+
+
 class EventHistory:
     """Fixed-capacity ring of past events, scanned by watchers that join
     with a `since` index (reference store/event_history.go)."""

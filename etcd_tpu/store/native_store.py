@@ -26,7 +26,7 @@ import time
 from typing import Callable, List, Optional
 
 from etcd_tpu.store import event as ev
-from etcd_tpu.store.event import Event, NodeExtern, ttl_of
+from etcd_tpu.store.event import Event, LazyWriteEvent, NodeExtern, ttl_of
 from etcd_tpu.store.store import Stats, normalize
 from etcd_tpu.store.watcher import Watcher, WatcherHub
 
@@ -215,8 +215,28 @@ class NativeStore:
         hub.notify(e)
         return e
 
-    def set_applied_many(self, paths: List[str],
-                         values: List[str]) -> int:
+    def set_applied_lazy(self, node_path: str, value: str,
+                         expire_time: Optional[float]):
+        """set_applied for a WAITER-HELD plain PUT: same C mutation and
+        ring append, but when no watcher is live the waiter gets the raw
+        descriptors wrapped in a LazyWriteEvent — the Event/NodeExtern
+        churn moves onto the HTTP thread that resolves it (do()). With a
+        live watcher the Event is built here anyway (fan-out needs it)
+        and returned directly; callers treat both shapes uniformly."""
+        now = self.clock()
+        nd, pd, idx = self._core.set(_norm(node_path), False, value,
+                                     expire_time, now)
+        hub = self.watcher_hub
+        if hub.quiet():
+            return LazyWriteEvent(nd, pd, idx, now)
+        e = Event(ev.SET, node=_extern(nd, now),
+                  prev_node=None if pd is None else _extern(pd, now),
+                  etcd_index=idx)
+        hub.notify(e)
+        return e
+
+    def set_applied_many(self, paths: List[str], values: List[str],
+                         need: Optional[List[int]] = None):
         """Batched plain-file PUTs for the engine apply loop: ONE
         GIL-atomic C call applies the whole batch (per-op etcd errors fail
         that op exactly like the scalar call — stats counted, index
@@ -237,7 +257,16 @@ class NativeStore:
         registrations, store/event_history.go) — so in that corner the
         hub is cleared: every raced watcher wakes with the
         WATCHER_CLEARED sentinel and re-registers, and a stale waitIndex
-        then gets the honest 401. Returns the number applied."""
+        then gets the honest 401.
+
+        `need`, when given, lists batch positions whose callers hold a
+        waiter: the C call returns a desc entry per listed position —
+        `(pos, nd, pd|None, index)` for an applied op, or
+        `(pos, None, (code, cause), index_at_failure)` for a per-op etcd
+        failure — and the return becomes `(applied, descs)` so the
+        applier can wake each waiter with raw descriptors instead of a
+        materialized Event. Without `need`, returns the number applied
+        (unchanged contract)."""
         now = self.clock()
         hub = self.watcher_hub
         want_recs = not hub.quiet()
@@ -246,12 +275,13 @@ class NativeStore:
         # instead of a _norm() call per request — the call alone was
         # ~35% of this method's time at deep-queue load (1 M calls/s).
         norm = _norm
-        first, last, failed, recs = self._core.set_many(
+        first, last, failed, recs, descs = self._core.set_many(
             [p if (p and p[0] == "/" and p[-1] != "/" and "//" not in p
                    and "." not in p) else norm(p) for p in paths],
-            values, now, want_recs)
+            values, now, want_recs, need)
+        applied = len(paths) - failed
         if last < first:
-            return len(paths) - failed
+            return applied if need is None else (applied, descs)
         if recs is not None:
             if not hub.quiet():
                 for nd, pd, idx in recs:
@@ -271,13 +301,13 @@ class NativeStore:
                 # semantics); re-registration with a stale waitIndex gets
                 # 401 EventIndexCleared from the next scan.
                 hub.clear()
-                return len(paths) - failed
+                return applied if need is None else (applied, descs)
             scan = hub.event_history.scan
             for i in range(lo, last + 1):
                 e = scan("/", True, i)
                 if e is not None and e.etcd_index <= last:
                     hub.notify(e)
-        return len(paths) - failed
+        return applied if need is None else (applied, descs)
 
     # -- mutations -----------------------------------------------------------
 

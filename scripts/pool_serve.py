@@ -16,9 +16,16 @@ separate etcd clusters behind a front. Scope: PER-TENANT paths and
 refused with 501 and run against shard ports directly — one shard
 answering for the pool would misreport it.
 
+Process sharding and the in-process applier pool compose:
+--applier-shards K gives EVERY shard process its own K-worker applier
+pool (engine.EngineConfig.applier_shards — the post-commit apply/ack
+path partitioned by tenant range inside one engine), so a single-shard
+pool (--shards 1 --applier-shards 4) exploits multiple cores without
+paying the router's process split, and a sharded pool multiplies both.
+
 Usage:
     python scripts/pool_serve.py --groups 16 --shards 2 --port 0 \
-        --data-dir /tmp/pool
+        --data-dir /tmp/pool [--applier-shards 4]
 Prints one JSON line {"router": port, "shards": [ports], "pids": [...]}
 then serves until SIGTERM. Tests drive it as a subprocess
 (tests/test_pool_serve.py).
@@ -149,6 +156,9 @@ def main() -> int:
     ap.add_argument("--shards", type=int, default=2)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--applier-shards", type=int, default=1,
+                    help="applier pool size INSIDE each shard process "
+                         "(engine --engine-applier-shards)")
     args = ap.parse_args()
     G, K = args.groups, args.shards
     if G % K:
@@ -165,6 +175,7 @@ def main() -> int:
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "etcd_tpu",
              "--engine-groups", str(per), "--engine-peers", "3",
+             "--engine-applier-shards", str(args.applier_shards),
              "--data-dir", os.path.join(args.data_dir, f"shard{k}"),
              "--listen-client-urls",
              f"http://127.0.0.1:{shard_ports[k]}"],

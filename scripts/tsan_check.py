@@ -5,16 +5,21 @@ runtime gets to the reference's `go test --race`, reference test:46-48).
 Builds storecore.c and walcodec.c with -fsanitize=thread into a temp
 dir, then exercises them from concurrent threads in a child process
 running under LD_PRELOAD=libtsan: 4 writer threads + a reader against
-one Core (the GIL serializes extension entry, but TSan still validates
-the C-level happens-before on every malloc'd structure), plus batched
-set_many and the WAL codec round-trip. Any `WARNING: ThreadSanitizer`
-in the child's output fails the check.
+one Core, plus the applier-pool shapes — K shard cores each driven by
+its own thread through set_many(need=...) (the per-shard apply +
+descriptor-wake path), and two threads hammering set_many on the SAME
+core (its batch mutation phase runs with the GIL released under the
+per-Core mutex, so this is real C-level concurrency, not GIL-serialized
+entry) against a concurrent reader — plus the WAL codec round-trip. Any
+`WARNING: ThreadSanitizer` in the child's output fails the check.
 
 Scope note (also in ./test): this instruments OUR C only. Python-level
 interleavings are covered by tests/test_race_stress.py's amplified
 scheduler; jax/XLA internals are out of scope.
 
-Usage: python scripts/tsan_check.py   (exit 0 = clean)
+Usage: python scripts/tsan_check.py                  (exit 0 = clean)
+       python scripts/tsan_check.py --if-available   (exit 0 + loud
+           skip when libtsan is not installed — the ./test default)
 """
 import glob
 import os
@@ -65,8 +70,49 @@ def codec():
         assert len(recs) == 1 and consumed == len(blob), (i, recs)
         walcodec.pack_multi([(1, b"\x00" + b"y" * 40)] * 8, 2)
 
+# Applier-pool shapes: K shard cores, each applied by its own thread
+# through set_many(need=...) — the per-shard apply + descriptor-wake
+# path (engine._flush_many) — and a SHARED core hit by two set_many
+# threads at once: its batch mutation phase drops the GIL under the
+# per-Core mutex, so these interleave in real C, with a reader walking
+# the same tree through the locked scalar path.
+shards = [storecore.Core(("/0", "/1")) for _ in range(4)]
+shared = storecore.Core(("/0", "/1"))
+
+def shard_applier(core, sid):
+    for b in range(60):
+        paths = ["/1/s%d_%d_%d" % (sid, b, i) for i in range(50)]
+        first, last, failed, recs, descs = core.set_many(
+            paths, ["v" * 16] * 50, 3.0, False, [0, 7, 49])
+        assert failed == 0 and len(descs) == 3, (failed, descs)
+        for pos, nd, pd, idx in descs:
+            assert nd[0] == paths[pos], (pos, nd)
+
+def contender(tid):
+    for b in range(100):
+        first, last, failed, recs, descs = shared.set_many(
+            ["/1/c%d_%d" % (tid, i) for i in range(40)],
+            ["w" * 12] * 40, 4.0, False, [0, 39])
+        assert failed == 0, failed
+        assert descs[0][1][0] == "/1/c%d_0" % tid
+
+def shared_reader():
+    hits = 0
+    for i in range(4000):
+        try:
+            shared.get("/1/c0_5", False, False)
+            hits += 1
+        except Exception as e:
+            if "not found" not in str(e) and "100" not in str(e):
+                raise
+    assert hits > 0, "shared reader never observed the key"
+
 ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
-      + [threading.Thread(target=reader), threading.Thread(target=codec)])
+      + [threading.Thread(target=reader), threading.Thread(target=codec)]
+      + [threading.Thread(target=shard_applier, args=(shards[k], k))
+         for k in range(4)]
+      + [threading.Thread(target=contender, args=(t,)) for t in range(2)]
+      + [threading.Thread(target=shared_reader)])
 for t in ts:
     t.start()
 for t in ts:
@@ -74,9 +120,10 @@ for t in ts:
 if thread_errors:
     print("TSAN-CHILD-THREAD-ERRORS:", thread_errors[:3])
     sys.exit(3)
-first, last, failed, _ = c.set_many(
+first, last, failed, recs, descs = c.set_many(
     ["/1/b%d" % i for i in range(200)], ["v"] * 200, 2.0, False)
-assert failed == 0 and last - first == 199
+assert failed == 0 and last - first == 199 and descs is None
+assert shared.index == 2 * 100 * 40
 print("TSAN-CHILD-OK", c.index)
 """
 
@@ -93,8 +140,16 @@ def find_libtsan():
 
 
 def main() -> int:
+    if_available = "--if-available" in sys.argv[1:]
     libtsan = find_libtsan()
     if libtsan is None:
+        if if_available:
+            # The default ./test path: run whenever the box can, skip
+            # LOUDLY when it can't — a silent skip would read as clean.
+            print("tsan_check: SKIPPED — libtsan not found on this box "
+                  "(install gcc's tsan runtime to enable the sanitizer "
+                  "tier; TSAN=1 ./test makes this a hard failure)")
+            return 0
         # The caller ASKED for the sanitizer tier: a silent pass would
         # be false confidence. Fail and say why.
         print("tsan_check: FAILED — libtsan not found on this box "
@@ -128,7 +183,9 @@ def main() -> int:
             print(out[-4000:])
             return 1
     print("tsan_check: OK — storecore + walcodec clean under "
-          "ThreadSanitizer (4 writers + reader + codec threads)")
+          "ThreadSanitizer (4 writers + reader + codec threads, 4 shard "
+          "appliers via set_many(need=...), 2 same-core set_many "
+          "contenders + reader)")
     return 0
 
 

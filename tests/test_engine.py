@@ -126,6 +126,34 @@ def test_engine_serves_puts_and_gets(tmp_path):
     eng.stop()
 
 
+def test_engine_mask_watchdog_repairs_corrupt_device_mask(tmp_path):
+    """The peer_mask liveness watchdog: membership truth lives in h_mask
+    (it flows host -> device only), so a corrupted DEVICE mask — the
+    observed donated-buffer failure mode: one active slot per group,
+    silencing all replication and suppressing campaigns — must be
+    detected and restored within mask_check_rounds, after which
+    replication resumes without outside help."""
+    import jax.numpy as jnp
+
+    eng = MultiEngine(make_cfg(tmp_path / "wd", mask_check_rounds=16))
+    run_until(eng, lambda: all(eng.leader_slot(g) >= 0 for g in range(4)),
+              msg="leaders")
+    t, out = put_async(eng, 0, "/a", "1")
+    settle(eng, t, out)
+    G, P = eng.cfg.groups, eng.cfg.peers
+    diag = np.zeros((G, P), bool)
+    diag[np.arange(G), np.arange(G) % P] = True
+    eng.st = eng.st._replace(peer_mask=jnp.asarray(diag))
+    for _ in range(eng.cfg.mask_check_rounds + 1):
+        eng.run_round()
+    assert eng.mask_repairs >= 1
+    assert np.array_equal(np.asarray(eng.st.peer_mask), eng.h_mask)
+    t, out = put_async(eng, 0, "/b", "2")
+    ev = settle(eng, t, out)
+    assert ev.node.value == "2"
+    eng.stop()
+
+
 def test_engine_background_thread_serving(tmp_path):
     eng = MultiEngine(make_cfg(tmp_path / "e2", round_interval=0.001))
     eng.start()

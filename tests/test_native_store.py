@@ -231,3 +231,64 @@ def test_set_many_inline_canonical_predicate_matches_norm():
             p = "".join(tup)
             if inline_ok(p):
                 assert _norm(p) == p, p
+
+
+def test_set_applied_many_need_returns_descriptors():
+    """With `need`, set_applied_many returns (applied, descs): one desc
+    per listed position — (pos, nd, pd|None, index) for an applied op,
+    (pos, None, (code, cause), index_at_failure) for a per-op etcd
+    failure — aligned with the scalar path's error parity."""
+    st = NativeStore(clock=Clock(), namespaces=("/0", "/1"))
+    st.set_applied_many(["/1/pre"], ["old"])
+    applied, descs = st.set_applied_many(
+        ["/1/a", "/", "/1/pre", "/1/b"],
+        ["1", "x", "new", "2"], need=[0, 1, 2])
+    assert applied == 3
+    assert len(descs) == 3
+    pos, nd, pd, idx = descs[0]
+    assert (pos, pd) == (0, None) and nd[0] == "/1/a" and nd[1] == "1"
+    assert idx == 2 and nd[4] == 2          # modified index
+    pos, nd, fail, idx = descs[1]           # root PUT: 107, cause "/"
+    assert pos == 1 and nd is None
+    assert fail == (errors.ECODE_ROOT_RONLY, "/")
+    pos, nd, pd, idx = descs[2]             # overwrite carries prev desc
+    assert pos == 2 and nd[1] == "new" and pd[1] == "old"
+    # need=None keeps the int contract
+    assert st.set_applied_many(["/1/c"], ["3"]) == 1
+
+
+def test_set_applied_lazy_defers_event_materialization(monkeypatch):
+    """With no watcher live, set_applied_lazy must not construct any
+    Event/NodeExtern at apply time — the waiter's LazyWriteEvent resolves
+    them later on the consuming thread. With a watcher live, the Event is
+    built eagerly (the fan-out needs it) and returned directly."""
+    from etcd_tpu.store import event as ev_mod
+    from etcd_tpu.store.event import LazyWriteEvent
+
+    st = NativeStore(clock=Clock(), namespaces=("/0", "/1"))
+    st.set_applied_lazy("/1/k", "v0", None)
+
+    def boom(*a, **kw):
+        raise AssertionError("Event materialized on the apply hot path")
+
+    monkeypatch.setattr(native_store, "Event", boom)
+    monkeypatch.setattr(native_store, "_extern", boom)
+    r = st.set_applied_lazy("/1/k", "v1", None)
+    monkeypatch.undo()
+
+    assert isinstance(r, LazyWriteEvent)
+    e = r.resolve()
+    assert e.action == ev_mod.SET
+    assert e.node.key == "/1/k" and e.node.value == "v1"
+    assert e.prev_node.value == "v0"
+    assert e.etcd_index == 2 and e.node.modified_index == 2
+    # C history recorded the lazy write: a since-scan replays it
+    replay = st.watcher_hub.event_history.scan("/1/k", False, 2)
+    assert replay is not None and replay.node.value == "v1"
+
+    # live watcher: falls back to an eager Event + notify
+    w = st.watch("/1", recursive=True, stream=True)
+    r2 = st.set_applied_lazy("/1/k", "v2", None)
+    assert not isinstance(r2, LazyWriteEvent)
+    got = w.next_event(timeout=1.0)
+    assert got is not None and got.node.value == "v2"
