@@ -537,11 +537,26 @@ def child_main() -> int:
         # overhead until there are cores to back it. Set 1 for the
         # single-applier baseline.
         K_appl = int(os.environ.get("BENCH_APPLIER_SHARDS", 2))
+        # WAL-writer compartment (EngineConfig.wal_shards /
+        # pipeline_wal): group-commit fsyncs happen on writer threads,
+        # off the round loop; S>1 shards the log into per-tenant-range
+        # streams with parallel fsyncs. Default 2: the measured sweet
+        # spot of the round-7 S in {1,2,4} sweep (docs/perf.md) —
+        # 1.14-1.18x deep-queue over the round-6 inline writer in
+        # same-box interleaved controls even on a 1-core box (halved
+        # per-stream fsyncs release the ack watermark sooner), while
+        # S=1 pipelined actually LOSES to inline there
+        # (the writer thread's GIL time stretches the round loop with
+        # no parallel-fsync payback). BENCH_WAL_PIPELINE=0 restores the
+        # round-6 inline append+fsync for A/B baselines.
+        S_wal = int(os.environ.get("BENCH_WAL_SHARDS", 2))
+        wal_pipe = os.environ.get("BENCH_WAL_PIPELINE", "1") != "0"
         with tempfile.TemporaryDirectory() as tmp:
             eng = MultiEngine(EngineConfig(
                 groups=G_e, peers=P, data_dir=tmp, window=16, max_ents=E,
                 heartbeat_tick=3, fsync=True, stagger=True,
-                applier_shards=K_appl,
+                applier_shards=K_appl, wal_shards=S_wal,
+                pipeline_wal=wal_pipe,
                 checkpoint_rounds=1 << 30))
             def all_led():
                 # Vectorized: leader_slot() per group is an O(G) Python
@@ -724,6 +739,12 @@ def child_main() -> int:
             apply_s = {k: v for k, v in eng.phase_s.items()
                        if k == "apply" or k.startswith("apply[")}
             n_shards = len(eng._appliers)
+            # Writer-compartment profile BEFORE stop closes the streams:
+            # per-group-commit fsync latency (measured IN the writer
+            # thread — satellite fix: the round loop only pays for the
+            # submit hand-off), batch size, and the submit-side queue
+            # depth.
+            wal_stats = eng.wal.stats()
             eng.stop()
         # Discard phase-B warmup (first 20% of the window): the paced rate
         # needs a few rounds to reach steady state.
@@ -754,13 +775,21 @@ def child_main() -> int:
         deep_txt = (f"deep-queue (depth {DEEP}) {deep_aps:,.0f} writes/s "
                     f"over {rd} rounds (p50 {dp50} p99 {dp99} ms); "
                     if deep_aps is not None else "")
-        log(f"[{label}] G={G_e} P={P} applier_shards={n_shards}: "
+        log(f"[{label}] G={G_e} P={P} applier_shards={n_shards} "
+            f"wal_shards={wal_stats['wal_shards']}"
+            f"{'' if wal_pipe else ' (wal pipeline OFF)'}: "
             f"{acked} acked writes in "
             f"{elapsed:.2f}s / {r} rounds -> {aps:,.0f} writes/s "
             f"(fsync on, depth {E}); {deep_txt}ack latency at "
             f"50% load p50 {p50} p99 {p99} ms over {len(b_lats)} samples "
             f"({rb} paced rounds); saturated p50 {sp50} p99 {sp99} ms; "
-            f"apply share {shard_share}")
+            f"apply share {shard_share}; wal fsync p50 "
+            f"{wal_stats['wal_fsync_p50_ms']} p99 "
+            f"{wal_stats['wal_fsync_p99_ms']} ms/commit, group-commit "
+            f"mean {wal_stats['wal_group_commit_mean']} max "
+            f"{wal_stats['wal_group_commit_max']} rounds, queue depth "
+            f"p50 {wal_stats['wal_queue_depth_p50']} max "
+            f"{wal_stats['wal_queue_depth_max']}")
         deep_keys = ({"deep_queue_acked_writes_per_sec": round(deep_aps, 1),
                       "deep_queue_depth": DEEP,
                       "deep_queue_rounds": rd,
@@ -772,6 +801,8 @@ def child_main() -> int:
                 "apply_share_per_shard": shard_share,
                 "commits_per_sec": round(aps, 1),
                 **deep_keys,
+                **wal_stats,
+                "wal_pipeline": wal_pipe,
                 "groups": G_e,
                 "rounds_pipelined": r,
                 "round_ms_pipelined": round(1000 * elapsed / max(r, 1), 3),
@@ -960,7 +991,7 @@ def _run_child(extra_env: dict, timeout_s: float):
     return best
 
 
-def _regression_gate(line: str) -> None:
+def _regression_gate(line: str, artifact_dir=None) -> None:
     """Diff the final result against the previous round's driver artifact
     (BENCH_r{N}.json) and flag >20% same-workload drops LOUDLY — the r04
     artifact shipped a churn number measured at a silently redefined
@@ -977,7 +1008,7 @@ def _regression_gate(line: str) -> None:
         cur = json.loads(line)
     except ValueError:
         return
-    root = os.path.dirname(os.path.abspath(__file__))
+    root = artifact_dir or os.path.dirname(os.path.abspath(__file__))
     arts = sorted(
         _g.glob(os.path.join(root, "BENCH_r*.json")),
         key=lambda p: int(_re.search(r"r(\d+)",
@@ -996,17 +1027,21 @@ def _regression_gate(line: str) -> None:
         return
     flags = []
 
-    def cmp(name, new, old, new_geom, old_geom):
+    def cmp(name, new, old, new_geom, old_geom, lower_better=False):
         if not new or not old:
             return
         if new_geom != old_geom:
             log(f"perf-gate: {name} not comparable to {prev_name} "
                 f"({new_geom} vs {old_geom})")
             return
-        if new < 0.8 * old:
+        # The same >20% rule both ways: throughput dropping below 0.8x,
+        # or a lower-better column (latency) rising above 1/0.8 = 1.25x.
+        worse = (new > old / 0.8) if lower_better else (new < 0.8 * old)
+        if worse:
+            pct = (new / old - 1) if lower_better else (1 - new / old)
             flags.append({"scenario": name, "now": new, "prev": old,
                           "prev_artifact": prev_name,
-                          "drop_pct": round(100 * (1 - new / old), 1)})
+                          "drop_pct": round(100 * pct, 1)})
 
     plat = cur.get("platform")
     prev_plat = prev.get("platform")
@@ -1034,6 +1069,22 @@ def _regression_gate(line: str) -> None:
               o.get("platform", prev_plat), prev.get("metric"))
         cmp(sc, v.get("commits_per_sec"), o.get("commits_per_sec"),
             ng, og)
+        # Round-7 columns, gated only when BOTH artifacts carry them
+        # (older rounds predate the writer compartment). Deep-queue
+        # throughput is the headline the WAL pipeline moves; fsync
+        # percentiles gate the other direction (a >20% latency RISE per
+        # group commit). The compartment's geometry is part of the
+        # tuple: wal_shards=4 vs 1 is a different workload, not a
+        # regression. Queue depth and batch size are load-dependent
+        # shapes, reported but not gated.
+        wg_n = ng + (v.get("applier_shards"), v.get("wal_shards"))
+        wg_o = og + (o.get("applier_shards"), o.get("wal_shards"))
+        cmp(f"{sc}.deep_queue",
+            v.get("deep_queue_acked_writes_per_sec"),
+            o.get("deep_queue_acked_writes_per_sec"), wg_n, wg_o)
+        for col in ("wal_fsync_p50_ms", "wal_fsync_p99_ms"):
+            cmp(f"{sc}.{col}", v.get(col), o.get(col), wg_n, wg_o,
+                lower_better=True)
     if flags:
         for fl in flags:
             log(f"PERF REGRESSION vs {fl['prev_artifact']}: "

@@ -97,6 +97,11 @@ class MainConfig:
     # ("groups", "peers") mesh of all visible devices, with this many on
     # the peers axis (1 = all devices on the groups axis).
     engine_mesh_peers_axis: int = 0
+    # Compartment widths inside the engine process (engine.EngineConfig
+    # applier_shards / wal_shards). Declared here — not just in _FLAGS —
+    # so a MainConfig built directly (embed, tests) boots the engine.
+    engine_applier_shards: int = 1
+    engine_wal_shards: int = 1
 
     @property
     def is_proxy(self) -> bool:
@@ -188,6 +193,10 @@ _FLAGS = [
     ("engine-applier-shards", int, 1,
      "Applier pool size: partition the post-commit apply/ack path by "
      "tenant range across N worker threads (1 = single applier)"),
+    ("engine-wal-shards", int, 1,
+     "WAL-writer pool size: shard the engine log into N per-tenant-range "
+     "segment streams with parallel group-commit fsyncs (1 = single "
+     "stream; an existing data dir may upgrade 1 -> N once)"),
 ]
 
 
@@ -286,6 +295,8 @@ def parse_args(argv: Sequence[str],
             raise ConfigError("-engine-mesh-peers-axis must be >= 0")
         if cfg.engine_applier_shards < 1:
             raise ConfigError("-engine-applier-shards must be >= 1")
+        if cfg.engine_wal_shards < 1:
+            raise ConfigError("-engine-wal-shards must be >= 1")
     if 5 * cfg.heartbeat_interval > cfg.election_timeout:
         raise ConfigError(
             f"-election-timeout[{cfg.election_timeout}ms] should be at least "

@@ -133,6 +133,7 @@ class EngineServer:
             data_dir=os.path.join(cfg.data_dir, DIR_ENGINE),
             round_interval=cfg.engine_interval_ms / 1000.0,
             applier_shards=cfg.engine_applier_shards,
+            wal_shards=cfg.engine_wal_shards,
             mesh=mesh))
         client_tls = TLSInfo(cert_file=cfg.cert_file, key_file=cfg.key_file,
                              ca_file=cfg.ca_file,

@@ -197,6 +197,13 @@ class EngineWAL:
         self._f = None
         self._crc = 0
         self._seq = -1
+        # Highest round_no held in a WHOLE, checksummed record of this
+        # stream (the stream's durable tail), maintained by replay() and
+        # the write side. -1 until either has seen a record. The sharded
+        # writer (walwriter.WALWriter) takes the min over its streams'
+        # tails as the consistent replay boundary.
+        self.last_round = -1
+        self._pending_round = -1  # appended but not yet sync()ed
 
     # -- write side ---------------------------------------------------------
 
@@ -213,21 +220,41 @@ class EngineWAL:
         buf, self._crc = native.encode_records([(rtype, payload)], self._crc)
         self._f.write(buf)
 
+    def append_nosync(self, rec: RoundRecord) -> None:
+        """Append one round record WITHOUT flushing or fsyncing — the
+        group-commit half of the writer compartment: a batch of these
+        followed by one sync() makes one fsync cover every queued round
+        (the generalization of the reference's batched Save,
+        wal/wal.go:459-487). The record is NOT durable until sync()."""
+        if self._f is None:
+            self._open_segment(rec.round_no)
+        self._write(REC_ROUND, rec.encode())
+        self._pending_round = max(self._pending_round, rec.round_no)
+
+    def sync(self) -> None:
+        """Flush + (optionally) fsync everything appended so far, then
+        rotate if the segment is over size. After this returns, every
+        append_nosync'd record is durable and last_round reflects it."""
+        if self._f is None:
+            return
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        if self._pending_round >= 0:
+            self.last_round = max(self.last_round, self._pending_round)
+            self._pending_round = -1
+        if self._f.tell() >= self.segment_size:
+            self._open_segment(self.last_round + 1)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+
     def append(self, rec: RoundRecord) -> None:
         """Append + (optionally) fsync one round record. MUST complete before
         the next kernel round consumes this round's messages (the batched
         persist-before-send contract, reference raft/doc.go:31-39)."""
-        if self._f is None:
-            self._open_segment(rec.round_no)
-        self._write(REC_ROUND, rec.encode())
-        self._f.flush()
-        if self.fsync:
-            os.fsync(self._f.fileno())
-        if self._f.tell() >= self.segment_size:
-            self._open_segment(rec.round_no + 1)
-            self._f.flush()
-            if self.fsync:
-                os.fsync(self._f.fileno())
+        self.append_nosync(rec)
+        self.sync()
 
     def close(self) -> None:
         if self._f is not None:
@@ -270,16 +297,89 @@ class EngineWAL:
             for rt, pl in recs:
                 if rt == REC_ROUND:
                     rec = RoundRecord.decode(pl)
+                    # Tail tracking covers EVERY whole record, filtered or
+                    # not: a stream whose records all predate the filter
+                    # is still complete through its tail.
+                    self.last_round = max(self.last_round, rec.round_no)
                     if rec.round_no > after_round:
                         yield rec
             self._crc = crc
         self._seq = max_seq
 
+    def cut_after(self, round_no: int) -> int:
+        """Physically drop every whole record with round > round_no and
+        position the appender at the cut. Returns the number of round
+        records dropped.
+
+        This is how the sharded writer reassembles a consistent boundary:
+        a crash between the per-range streams' parallel fsyncs leaves
+        some streams with whole, checksummed records whose batch never
+        became durable on every sibling stream — those rounds were never
+        acked (acks gate on the min-over-streams watermark), but they
+        MUST NOT survive on disk, or the next crash-restart would replay
+        them alongside reused round numbers carrying different content.
+        Call after replay() (which positions _seq past every segment)."""
+        dropped = 0
+        cutting = False
+        for name in self._segments():
+            path = os.path.join(self.dir, name)
+            with open(path, "rb") as f:
+                data = f.read()
+            # Walk frames exactly like replay: chain the rolling CRC and
+            # stop at the first torn/corrupt frame.
+            off, crc, cut_off, good_crc = 0, 0, None, None
+            while off + _HDR.size <= len(data):
+                rtype, rcrc, ln = _HDR.unpack_from(data, off)
+                if off + _HDR.size + ln > len(data):
+                    break
+                payload = data[off + _HDR.size:off + _HDR.size + ln]
+                if off == 0:
+                    if rtype != REC_CRC:
+                        break
+                    (seed,) = struct.unpack("<I", payload)
+                    crc = zlib.crc32(payload, seed) & 0xFFFFFFFF
+                else:
+                    crc = zlib.crc32(payload, crc) & 0xFFFFFFFF
+                if crc != rcrc:
+                    break
+                if cut_off is None and rtype == REC_ROUND:
+                    (r,) = struct.unpack_from("<I", payload, 0)
+                    if r > round_no:
+                        cut_off = off   # rounds are append-monotonic:
+                        # everything from here on is beyond the boundary
+                if cut_off is not None:
+                    if rtype == REC_ROUND:
+                        dropped += 1
+                else:
+                    good_crc = crc
+                off += _HDR.size + ln
+            if cutting:
+                os.unlink(path)
+                continue
+            if cut_off is not None:
+                if good_crc is None:
+                    # Even the CRC head fell beyond the cut (impossible:
+                    # the head is not a round record) — drop the segment.
+                    os.unlink(path)
+                else:
+                    with open(path, "r+b") as f:
+                        f.truncate(cut_off)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    self._crc = good_crc
+                cutting = True
+        if cutting:
+            fsync_dir(self.dir)
+            self.last_round = min(self.last_round, round_no)
+        return dropped
+
     # -- checkpoints --------------------------------------------------------
 
-    def save_checkpoint(self, round_no: int, state: dict) -> None:
+    def save_checkpoint(self, round_no: int, state: dict) -> int:
         """Atomically persist a full engine checkpoint, then purge segments
-        that predate it (every record they hold is round <= round_no)."""
+        that predate it (every record they hold is round <= round_no).
+        Returns the fallback round segment retention serves — the sharded
+        writer purges its per-range streams against the same value."""
         path = os.path.join(self.dir, _ckpt_name(round_no))
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -299,6 +399,14 @@ class EngineWAL:
         # the previous one and needs every round after ITS round — purging
         # up to the newest would silently lose that span.
         fallback_round = int(ckpts[0][len("checkpoint-"):-len(".json")], 16)
+        self.purge_segments(fallback_round)
+        return fallback_round
+
+    def purge_segments(self, fallback_round: int) -> None:
+        """Drop segments every record of which is round <= fallback_round
+        (covered by a retained checkpoint). A segment is droppable iff the
+        NEXT segment's first round says so — the newest segment always
+        stays (it is the append target)."""
         segs = self._segments()
         for i, name in enumerate(segs[:-1]):
             _, nxt_round = _parse_seg(segs[i + 1])
@@ -340,10 +448,26 @@ def load_terms(dirname: str, groups: int) -> np.ndarray:
         ckpt_round, ckpt = wal.load_checkpoint()
         if ckpt is not None:
             terms = b64_np(ckpt["term"]).astype(np.int32).copy()
-        for rec in wal.replay(after_round=ckpt_round):
-            for g, t in zip(rec.hs_g, rec.hs_term):
-                if g < groups:
-                    terms[g] = t
+        # Streams: the root dir plus any per-range shard streams a
+        # sharded writer (walwriter.WALWriter) left behind. Terms are
+        # monotonic per group, so the elementwise max across streams IS
+        # the final value — no merged round ordering needed, and records
+        # beyond the crash boundary only ever raise the floor (safe:
+        # this host really did persist that term).
+        dirs = [dirname] + [os.path.join(dirname, n)
+                            for n in sorted(os.listdir(dirname))
+                            if n.startswith("wal-shard-")
+                            and os.path.isdir(os.path.join(dirname, n))]
+        for d in dirs:
+            w = wal if d == dirname else EngineWAL(d)
+            try:
+                for rec in w.replay(after_round=ckpt_round):
+                    for g, t in zip(rec.hs_g, rec.hs_term):
+                        if g < groups:
+                            terms[g] = max(terms[g], t)
+            finally:
+                if w is not wal:
+                    w.close()
     finally:
         wal.close()
     return terms

@@ -16,16 +16,20 @@ separate etcd clusters behind a front. Scope: PER-TENANT paths and
 refused with 501 and run against shard ports directly — one shard
 answering for the pool would misreport it.
 
-Process sharding and the in-process applier pool compose:
+Process sharding and the in-process compartments compose:
 --applier-shards K gives EVERY shard process its own K-worker applier
 pool (engine.EngineConfig.applier_shards — the post-commit apply/ack
-path partitioned by tenant range inside one engine), so a single-shard
-pool (--shards 1 --applier-shards 4) exploits multiple cores without
-paying the router's process split, and a sharded pool multiplies both.
+path partitioned by tenant range inside one engine) and --wal-shards S
+gives each its own S-stream WAL-writer pool (EngineConfig.wal_shards —
+per-tenant-range segment streams with parallel group-commit fsyncs), so
+a single-shard pool (--shards 1 --applier-shards 4 --wal-shards 4)
+exploits multiple cores without paying the router's process split, and
+a sharded pool multiplies all three (M x K appliers, M x S fsync
+streams — the aggregate scale curve in BENCH_r06.json).
 
 Usage:
     python scripts/pool_serve.py --groups 16 --shards 2 --port 0 \
-        --data-dir /tmp/pool [--applier-shards 4]
+        --data-dir /tmp/pool [--applier-shards 4] [--wal-shards 4]
 Prints one JSON line {"router": port, "shards": [ports], "pids": [...]}
 then serves until SIGTERM. Tests drive it as a subprocess
 (tests/test_pool_serve.py).
@@ -159,6 +163,10 @@ def main() -> int:
     ap.add_argument("--applier-shards", type=int, default=1,
                     help="applier pool size INSIDE each shard process "
                          "(engine --engine-applier-shards)")
+    ap.add_argument("--wal-shards", type=int, default=1,
+                    help="WAL-writer pool size INSIDE each shard process: "
+                         "per-tenant-range segment streams with parallel "
+                         "group-commit fsyncs (engine --engine-wal-shards)")
     args = ap.parse_args()
     G, K = args.groups, args.shards
     if G % K:
@@ -176,6 +184,7 @@ def main() -> int:
             [sys.executable, "-m", "etcd_tpu",
              "--engine-groups", str(per), "--engine-peers", "3",
              "--engine-applier-shards", str(args.applier_shards),
+             "--engine-wal-shards", str(args.wal_shards),
              "--data-dir", os.path.join(args.data_dir, f"shard{k}"),
              "--listen-client-urls",
              f"http://127.0.0.1:{shard_ports[k]}"],

@@ -107,12 +107,63 @@ def shared_reader():
                 raise
     assert hits > 0, "shared reader never observed the key"
 
+# WAL-writer compartment shapes (engine walwriter.WALWriter): S writer
+# threads each own a stream — a queue of (ticket, payload) batches
+# encoded through walcodec with that stream's OWN rolling crc chain
+# (encode_records runs C against S-way concurrency here), then publish
+# a durable ticket under the watermark lock; a submitter fans every
+# ticket out to all streams (the submit hand-off), and a waiter gates
+# on min-over-streams durability exactly like ack release does.
+WS = 3
+wm = threading.Condition()
+wal_durable = [0] * WS
+wal_qs = [[] for _ in range(WS)]
+wal_cvs = [threading.Condition() for _ in range(WS)]
+WAL_TICKETS = 400
+
+def wal_writer(k):
+    crc = 0
+    done = 0
+    while done < WAL_TICKETS:
+        with wal_cvs[k]:
+            while not wal_qs[k]:
+                wal_cvs[k].wait(5)
+            batch, wal_qs[k][:] = list(wal_qs[k]), []
+        before = crc
+        blob, crc = walcodec.encode_records(
+            [(2, pl) for _, pl in batch], crc)
+        recs, _, consumed = walcodec.scan_records(blob, before)
+        assert len(recs) == len(batch) and consumed == len(blob)
+        done = batch[-1][0]
+        with wm:
+            wal_durable[k] = done
+            wm.notify_all()
+
+def wal_submitter():
+    for t in range(1, WAL_TICKETS + 1):
+        pl = b"r" * (20 + t % 7)
+        for k in range(WS):
+            with wal_cvs[k]:
+                wal_qs[k].append((t, pl))
+                wal_cvs[k].notify_all()
+
+def wal_waiter():
+    for t in (WAL_TICKETS // 3, WAL_TICKETS):
+        with wm:
+            while min(wal_durable) < t:
+                wm.wait(10)
+        assert min(wal_durable) >= t
+
 ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
       + [threading.Thread(target=reader), threading.Thread(target=codec)]
       + [threading.Thread(target=shard_applier, args=(shards[k], k))
          for k in range(4)]
       + [threading.Thread(target=contender, args=(t,)) for t in range(2)]
-      + [threading.Thread(target=shared_reader)])
+      + [threading.Thread(target=shared_reader)]
+      + [threading.Thread(target=wal_writer, args=(k,))
+         for k in range(WS)]
+      + [threading.Thread(target=wal_submitter),
+         threading.Thread(target=wal_waiter)])
 for t in ts:
     t.start()
 for t in ts:
@@ -120,6 +171,7 @@ for t in ts:
 if thread_errors:
     print("TSAN-CHILD-THREAD-ERRORS:", thread_errors[:3])
     sys.exit(3)
+assert min(wal_durable) == WAL_TICKETS, wal_durable
 first, last, failed, recs, descs = c.set_many(
     ["/1/b%d" % i for i in range(200)], ["v"] * 200, 2.0, False)
 assert failed == 0 and last - first == 199 and descs is None
@@ -185,7 +237,8 @@ def main() -> int:
     print("tsan_check: OK — storecore + walcodec clean under "
           "ThreadSanitizer (4 writers + reader + codec threads, 4 shard "
           "appliers via set_many(need=...), 2 same-core set_many "
-          "contenders + reader)")
+          "contenders + reader, 3 WAL-writer streams + submitter + "
+          "watermark waiter)")
     return 0
 
 

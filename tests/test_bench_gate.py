@@ -98,3 +98,77 @@ def test_gate_scenario_subset_not_compared(capsys):
     out = capsys.readouterr()
     assert "PERF REGRESSION" not in out.err
     assert "not comparable" in out.err
+
+
+# -- round-7 writer-compartment columns --------------------------------------
+# These drive the gate against a synthetic artifact dir (the
+# artifact_dir hook) so they don't depend on what the tree's newest
+# driver artifact happens to carry.
+
+def _mk_artifact(tmp, engine_cols):
+    parsed = {"metric": "commits_per_sec_64_groups_5_peers",
+              "value": 12345.0, "scenario": "uniform", "platform": "cpu",
+              "scenarios": {"engine": {"groups": 64, **engine_cols}}}
+    with open(os.path.join(str(tmp), "BENCH_r01.json"), "w") as f:
+        json.dump({"parsed": parsed}, f)
+    return parsed
+
+
+def _cur_line(prev, engine_cols):
+    return json.dumps({"metric": prev["metric"], "value": prev["value"],
+                       "scenario": prev["scenario"],
+                       "platform": prev["platform"],
+                       "scenarios": {"engine": {"groups": 64,
+                                                **engine_cols}}})
+
+
+_BASE = {"commits_per_sec": 100_000.0, "applier_shards": 2,
+         "wal_shards": 1,
+         "deep_queue_acked_writes_per_sec": 200_000.0,
+         "wal_fsync_p50_ms": 2.0, "wal_fsync_p99_ms": 8.0}
+
+
+def test_gate_flags_deep_queue_drop_and_fsync_rise(tmp_path, capsys):
+    """The new columns gate both directions: deep-queue throughput
+    dropping >20%, and per-group-commit fsync latency rising >25%."""
+    bench = _load_bench()
+    prev = _mk_artifact(tmp_path, _BASE)
+    cur = dict(_BASE, deep_queue_acked_writes_per_sec=140_000.0,
+               wal_fsync_p99_ms=11.0)
+    bench._regression_gate(_cur_line(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" in out.err
+    emitted = json.loads(out.out.strip().splitlines()[-1])
+    flagged = {f["scenario"] for f in emitted["perf_regressions"]}
+    assert flagged == {"engine.deep_queue", "engine.wal_fsync_p99_ms"}
+    rise = [f for f in emitted["perf_regressions"]
+            if f["scenario"] == "engine.wal_fsync_p99_ms"][0]
+    assert rise["now"] == 11.0 and rise["drop_pct"] > 20
+
+
+def test_gate_wal_columns_absent_in_old_artifact_silent(tmp_path, capsys):
+    """Artifacts that predate the writer compartment carry none of the
+    new columns — the gate must stay silent, not crash or misfire."""
+    bench = _load_bench()
+    prev = _mk_artifact(tmp_path, {"commits_per_sec": 100_000.0})
+    bench._regression_gate(_cur_line(prev, _BASE),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert not out.out.strip()
+
+
+def test_gate_wal_shards_change_not_comparable(tmp_path, capsys):
+    """wal_shards (like applier_shards) is gate geometry: a 1 -> 4
+    sweep is a different workload, never a regression."""
+    bench = _load_bench()
+    prev = _mk_artifact(tmp_path, _BASE)
+    cur = dict(_BASE, wal_shards=4,
+               deep_queue_acked_writes_per_sec=100_000.0,
+               wal_fsync_p99_ms=30.0)
+    bench._regression_gate(_cur_line(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert "not comparable" in out.err
