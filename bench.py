@@ -60,6 +60,36 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _metrics_snapshot():
+    """Flat registry snapshot, bucket series dropped for size (the
+    _count/_sum pair already summarizes each histogram). Lazy import:
+    the parent watchdog must never pull the engine stack."""
+    from etcd_tpu.utils.metrics import REGISTRY
+    return {k: v for k, v in REGISTRY.snapshot().items()
+            if not k.split("{", 1)[0].endswith("_bucket")}
+
+
+def _metrics_delta(before, after):
+    """What the registry saw during one scenario: monotone series
+    (_total/_count/_sum) as after-minus-before movement, gauges as
+    their final value (a depth-gauge 'delta' means nothing). Series
+    born mid-scenario count from zero. This is the cross-check column:
+    the BENCH numbers and /metrics must tell the same story — e.g.
+    etcd_engine_acked_requests_total's movement here must equal the
+    scenario's own acked count (tests/test_observability.py asserts
+    the same invariant in-process)."""
+    out = {}
+    for k, v in sorted(after.items()):
+        base = k.split("{", 1)[0]
+        if base.endswith(("_total", "_count", "_sum")):
+            d = v - before.get(k, 0.0)
+            if d:
+                out[k] = round(d, 6)
+        else:
+            out[k] = round(v, 6)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Child: the actual measurement
 # ---------------------------------------------------------------------------
@@ -682,7 +712,8 @@ def child_main() -> int:
             DEEP = 64
             deep_aps = rd = None
             deep_samples = []
-            if (label == "engine" and G_e * DEEP <= 2_000_000
+            if (label.split("/", 1)[0] == "engine"
+                    and G_e * DEEP <= 2_000_000
                     and time.time() < sc_deadline - 5.0):
                 deep_end = time.time() + 0.3 * (sc_deadline - time.time())
                 d0 = eng.acked_requests
@@ -813,6 +844,49 @@ def child_main() -> int:
                 "saturated_p99_ms": sp99,
                 "fsync": True}
 
+    def measure_obs_ab(sc_deadline, pairs):
+        """Instrumentation-overhead A/B (BENCH_OBS_AB=N pairs): the
+        engine scenario run 2N times on this same box with the
+        observability plane alternately DISABLED (ETCD_TPU_OBS=off —
+        the round-7 baseline side: no histograms, dead flight ring,
+        tracer off) and enabled, interleaved off/on/off/on so slow
+        drift (thermal, page cache, background load) cancels instead of
+        landing on one side. Reports the mean deep-queue throughput
+        cost as obs_overhead_pct on the ON leg's result (budget:
+        <= 3%, gated by _regression_gate)."""
+        legs = []
+        n = 2 * pairs
+        t0 = time.time()
+        span = max(sc_deadline - t0, 1.0)
+        prev_env = os.environ.get("ETCD_TPU_OBS")
+        try:
+            for i in range(n):
+                mode = "off" if i % 2 == 0 else "on"
+                os.environ["ETCD_TPU_OBS"] = mode
+                legs.append((mode, measure_engine(
+                    min(t0 + span * (i + 1) / n, sc_deadline),
+                    label=f"engine/obs-{mode}")))
+        finally:
+            if prev_env is None:
+                os.environ.pop("ETCD_TPU_OBS", None)
+            else:
+                os.environ["ETCD_TPU_OBS"] = prev_env
+        col = "deep_queue_acked_writes_per_sec"
+        offs = [r[col] for m, r in legs if m == "off" and r.get(col)]
+        ons = [r[col] for m, r in legs if m == "on" and r.get(col)]
+        out = dict(next((r for m, r in reversed(legs) if m == "on"),
+                        legs[-1][1]))
+        if offs and ons:
+            off_m = sum(offs) / len(offs)
+            on_m = sum(ons) / len(ons)
+            out["obs_overhead_pct"] = round(100 * (1 - on_m / off_m), 2)
+            out["obs_ab"] = {"pairs": pairs, "deep_queue_off": offs,
+                             "deep_queue_on": ons}
+            log(f"[engine/obs-ab] deep-queue off {off_m:,.0f} vs on "
+                f"{on_m:,.0f} writes/s -> overhead "
+                f"{out['obs_overhead_pct']}% ({pairs} interleaved pairs)")
+        return out
+
     sel = scenario
     # churn LAST: it boots a second kernel geometry (7 peers, BASELINE
     # config 5) whose compile can eat a cold-cache TPU budget — the
@@ -874,6 +948,13 @@ def child_main() -> int:
             "scenarios": {k: v for k, v in results.items()
                           if k != order[0]},
         }
+        # The primary scenario's dict is otherwise reduced to the
+        # headline columns; the observability columns must reach the
+        # artifact even when engine leads the run (BENCH_SCENARIO=engine
+        # BENCH_OBS_AB=N is exactly that shape).
+        for extra in ("obs_overhead_pct", "obs_ab", "metrics_delta"):
+            if extra in primary:
+                out[extra] = primary[extra]
         print(json.dumps(out), flush=True)
 
     for i, (sc, share) in enumerate(zip(order, shares)):
@@ -881,8 +962,13 @@ def child_main() -> int:
             log(f"budget exhausted; skipping scenarios {order[i:]}")
             break
         sc_deadline = min(time.time() + remaining * share, deadline)
+        snap0 = _metrics_snapshot()
         if sc == "engine":
-            results[sc] = measure_engine(sc_deadline)
+            ab_pairs = int(os.environ.get("BENCH_OBS_AB", "0"))
+            if ab_pairs:
+                results[sc] = measure_obs_ab(sc_deadline, ab_pairs)
+            else:
+                results[sc] = measure_engine(sc_deadline)
         elif sc == "latency":
             # The per-chip shard shape: 100k north-star groups / 8 chips.
             # Most of the budget goes to the paced 50%-load phase — this
@@ -921,6 +1007,8 @@ def child_main() -> int:
             res, st, inbox = measure(sc, st, inbox, sc_deadline, rounds)
             results[sc] = res
         results[sc].setdefault("platform", devs[0].platform)
+        results[sc]["metrics_delta"] = _metrics_delta(
+            snap0, _metrics_snapshot())
         emit(results)
     return 0
 
@@ -1085,6 +1173,24 @@ def _regression_gate(line: str, artifact_dir=None) -> None:
         for col in ("wal_fsync_p50_ms", "wal_fsync_p99_ms"):
             cmp(f"{sc}.{col}", v.get(col), o.get(col), wg_n, wg_o,
                 lower_better=True)
+        # Instrumentation-overhead budget: the observability plane may
+        # cost at most 3% of deep-queue throughput in its own
+        # interleaved A/B (absolute budget, not vs the prior artifact —
+        # the A/B already carries its own baseline side).
+        ov = v.get("obs_overhead_pct")
+        if ov is not None and ov > 3.0:
+            flags.append({"scenario": f"{sc}.obs_overhead_pct",
+                          "now": ov, "prev": 3.0,
+                          "prev_artifact": "obs-overhead-budget",
+                          "drop_pct": round(ov, 1)})
+    # The overhead budget also applies when engine LED the run and its
+    # columns ride the top level (see emit's passthrough).
+    ov0 = cur.get("obs_overhead_pct")
+    if ov0 is not None and ov0 > 3.0:
+        flags.append({"scenario": f"{cur.get('scenario')}.obs_overhead_pct",
+                      "now": ov0, "prev": 3.0,
+                      "prev_artifact": "obs-overhead-budget",
+                      "drop_pct": round(ov0, 1)})
     if flags:
         for fl in flags:
             log(f"PERF REGRESSION vs {fl['prev_artifact']}: "

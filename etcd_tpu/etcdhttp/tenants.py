@@ -96,6 +96,9 @@ class TenantAPI:
         router.add("/tenants", self.handle_tenants_root, exact=True)
         router.add("/tenants/", self.handle_tenants)
         router.add("/engine/status", self.handle_engine_status)
+        router.add("/metrics", self.handle_metrics)
+        router.add("/debug/flight", self.handle_debug_flight)
+        router.add("/debug/traces", self.handle_debug_traces)
         router.add("/health", self.handle_health)
         router.add("/version", self.handle_version)
 
@@ -334,6 +337,52 @@ class TenantAPI:
             if v is not None:
                 out[k] = v
         ctx.send_json(200, out)
+
+    def handle_metrics(self, ctx: Ctx, suffix: str) -> None:
+        """GET /metrics — Prometheus text exposition of every registered
+        series (reference etcdserver metrics.go + pkg/metrics): the
+        proposal reference metrics, per-compartment histograms and
+        gauges (round loop, WAL writer shards, applier shards, ack
+        gate), and process stats."""
+        from etcd_tpu.utils.metrics import REGISTRY, fd_usage
+        used, limit = fd_usage()
+        extra = [
+            "# HELP process_open_fds Number of open file descriptors.",
+            "# TYPE process_open_fds gauge",
+            f"process_open_fds {float(used)}",
+            "# HELP process_max_fds Maximum number of open file "
+            "descriptors.",
+            "# TYPE process_max_fds gauge",
+            f"process_max_fds {float(limit)}",
+            "",
+        ]
+        body = (REGISTRY.expose() + "\n".join(extra)).encode()
+        ctx.send(200, body, "text/plain; version=0.0.4")
+
+    def handle_debug_flight(self, ctx: Ctx, suffix: str) -> None:
+        """GET /debug/flight — the round flight recorder as Chrome
+        trace-event JSON (load in chrome://tracing / Perfetto). POST
+        dumps the same snapshot to <data_dir>/diagnostics/ on disk."""
+        obs = getattr(self.engine, "obs", None)
+        if obs is None:
+            ctx.send_json(404, {"message": "engine has no flight "
+                                           "recorder"})
+            return
+        if ctx.method == "POST":
+            path = self.engine.dump_flight("http")
+            ctx.send_json(200, {"dumped": path})
+            return
+        ctx.send_json(200, obs.flight.to_trace_events())
+
+    def handle_debug_traces(self, ctx: Ctx, suffix: str) -> None:
+        """GET /debug/traces — sampled end-to-end proposal spans (stage
+        -> relative seconds per request id); empty unless
+        ETCD_TPU_TRACE_EVERY is set."""
+        obs = getattr(self.engine, "obs", None)
+        if obs is None:
+            ctx.send_json(404, {"message": "engine has no tracer"})
+            return
+        ctx.send_json(200, obs.tracer.dump())
 
     def handle_health(self, ctx: Ctx, suffix: str) -> None:
         ctx.send_json(200, {"health": "true"})

@@ -61,9 +61,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from etcd_tpu import errors
+from etcd_tpu.server import obs as obs_mod
 from etcd_tpu.server.enginewal import (CONF_ADD, CONF_REMOVE, EngineWAL,
                                        RoundRecord, b64_np, np_b64)
 from etcd_tpu.server.walwriter import WALWriter
+from etcd_tpu.utils import metrics
 from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, METHOD_QGET, METHOD_SYNC,
                                      Request)
@@ -421,13 +423,26 @@ class MultiEngine:
         # (one writer thread per key); the round loop records only the
         # cheap "wal_submit" hand-off.
         self.phase_s: Dict[str, float] = {}
+        # Observability plane (obs.py): per-compartment Prometheus
+        # series with children pre-bound to this engine's shard
+        # geometry, the round flight recorder, and the sampled proposal
+        # tracer. Constructed before the WAL writer and applier pool so
+        # both compartments can record into it. ETCD_TPU_OBS=off keeps
+        # it inert (the overhead A/B's baseline side).
+        self.obs = obs_mod.EngineObs(
+            wal_shards=max(1, min(cfg.wal_shards, G)),
+            applier_shards=max(1, min(cfg.applier_shards, G)))
+        # Requests admitted into this round's entries / sampled rids
+        # admitted this round (round-thread-private, reset per round).
+        self._last_admitted = 0
+        self._trace_rids: List[int] = []
         # The WAL compartment: submit() hands records to the writer
         # stage; acks gate on its durability watermark (wait_durable).
         # Constructed after phase_s — the writer threads profile into it.
         self.wal = WALWriter(cfg.data_dir, groups=G,
                              shards=cfg.wal_shards, fsync=cfg.fsync,
                              queue_rounds=cfg.wal_queue_rounds,
-                             phase_s=self.phase_s)
+                             phase_s=self.phase_s, obs=self.obs)
         # Last few durable round records, kept for the violation dump.
         self._recent_recs: deque = deque(maxlen=8)
         self.failed: Optional[Exception] = None
@@ -742,9 +757,31 @@ class MultiEngine:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        self._install_flight_signal()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="multi-engine")
         self._thread.start()
+
+    def dump_flight(self, reason: str = "manual") -> Optional[str]:
+        """Write the flight-recorder ring as Chrome trace-event JSON
+        under <data_dir>/diagnostics; returns the path (None on
+        failure). Also reachable via SIGUSR2 and GET /debug/flight."""
+        return self.obs.flight.dump(self.cfg.data_dir, reason)
+
+    def _install_flight_signal(self) -> None:
+        """SIGUSR2 -> flight dump. Best-effort: only the main thread
+        may install handlers (tests start engines from worker threads),
+        and with several engines in one process the last one started
+        owns the signal — the /debug/flight endpoint and fail-stop
+        auto-dump cover the rest."""
+        import signal as _signal
+        if not hasattr(_signal, "SIGUSR2"):
+            return
+        try:
+            _signal.signal(_signal.SIGUSR2,
+                           lambda _s, _f: self.dump_flight("sigusr2"))
+        except ValueError:
+            pass
 
     def stop(self) -> None:
         self._stop_ev.set()
@@ -789,10 +826,11 @@ class MultiEngine:
         holding it, the ring/last arrays it resolves terms from, and the
         WAL durability ticket ack release gates on (wait_durable). The
         mirror arrays are replaced (never mutated) each round, so handing
-        references across threads is safe."""
+        references across threads is safe. The trailing round number is
+        for the flight recorder's applied/acked marks."""
         c = np.where(self.h_mask, self.h_commit, 0)
         return (c.max(axis=1), c.argmax(axis=1), self.h_ring, self.h_last,
-                self.wal.ticket)
+                self.wal.ticket, self.round_no)
 
     def _ensure_appliers(self) -> None:
         for sh in self._appliers:
@@ -814,6 +852,8 @@ class MultiEngine:
         # comparable with pre-pool captures), "apply[k]" per worker
         # otherwise — each key has exactly one writer thread.
         pkey = "apply" if len(self._appliers) == 1 else f"apply[{sh.idx}]"
+        o = self.obs if self.obs.enabled else None
+        tr = self.obs.tracer
         while True:
             with sh.cv:
                 while not sh.q and not sh.stop:
@@ -831,13 +871,30 @@ class MultiEngine:
                 self._apply_committed(trigger=True, view=view,
                                       g_lo=sh.g_lo, g_hi=sh.g_hi,
                                       acct=sh.acct, sink=batch)
+                if o:
+                    o.flight.mark(view[5], obs_mod.APPLIED)
                 if batch.acked or batch.items:
+                    t_gate = time.perf_counter()
                     self.wal.wait_durable(view[4])
+                    if o:
+                        o.h_ack_wait.observe(time.perf_counter()
+                                             - t_gate)
+                    if tr.every:
+                        for rid, _res in batch.items:
+                            tr.mark(rid, "durable", ticket=view[4])
                     for rid, res in batch.items:
                         self.wait.trigger(rid, res)
+                        if tr.every:
+                            tr.mark(rid, "acked")
                     sh.acct.acked += batch.acked
+                    if o:
+                        o.c_acked.inc(batch.acked)
+                        o.h_appl_batch[sh.idx].observe(batch.acked)
+                        o.flight.mark(view[5], obs_mod.ACKED)
             except Exception as e:  # noqa: BLE001 — re-raised at the seam
                 log.exception("engine applier shard %d failed", sh.idx)
+                self.obs.flight.dump(self.cfg.data_dir,
+                                     f"applier-shard-{sh.idx}")
                 with sh.cv:
                     sh.exc = e
                     sh.cv.notify_all()
@@ -857,12 +914,15 @@ class MultiEngine:
         ack latency under saturation; a sum-bound would let one hot
         shard spend the other shards' latency budget)."""
         self._ensure_appliers()
+        o = self.obs if self.obs.enabled else None
         for sh in self._appliers:
             with sh.cv:
                 while (len(sh.q) >= self.cfg.apply_queue_rounds
                        and sh.exc is None):
                     sh.cv.wait(0.5)
                 sh.q.append(view)
+                if o:
+                    o.g_appl_queue[sh.idx].set(len(sh.q))
                 sh.cv.notify_all()
         self._raise_apply_exc()
 
@@ -948,6 +1008,10 @@ class MultiEngine:
                                    cause=f"bad method {r.method}")
         if r.id == 0:
             r = Request(**{**r.__dict__, "id": self.reqid.next()})
+        obs_on = self.obs.enabled
+        tr = self.obs.tracer
+        if tr.every:
+            tr.mark(r.id, "submit", g=g)
         q = self.wait.register(r.id)
         payload = bytes([P_REQ]) + r.encode()
         with self._lock:
@@ -955,14 +1019,30 @@ class MultiEngine:
             # re-parses JSON it already has (replay still decodes bytes).
             self._pending[g].append((r.id, payload, r))
             self._dirty.add(g)
+        # Reference proposal metrics (etcdserver/metrics.go), previously
+        # observed only by the legacy server.py path.
+        if obs_on:
+            metrics.propose_pending.inc()
+        t0 = time.perf_counter()
         try:
             result = q.get(timeout=timeout or self.cfg.request_timeout)
         except queue.Empty:
+            if obs_on:
+                metrics.propose_failed.inc()
             self.wait.cancel(r.id)
             raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
                                    cause="request timed out",
                                    index=int(self.applied[g]))
+        finally:
+            if obs_on:
+                metrics.propose_pending.dec()
+        if obs_on:
+            metrics.propose_durations.observe(
+                (time.perf_counter() - t0) * 1000.0)
         if isinstance(result, errors.EtcdError):
+            # Application-level error (e.g. a failed CAS) — served, not
+            # a failed proposal; propose_failed counts only proposals
+            # that never produced a result.
             raise result
         if type(result) is LazyWriteEvent:
             # The ack/waiter stage woke us with raw C descriptors; the
@@ -1234,6 +1314,12 @@ class MultiEngine:
         jnp, kernel = self._jnp, self._kernel
         G, P, W, E = (self.cfg.groups, self.cfg.peers, self.cfg.window,
                       self.cfg.max_ents)
+        o = self.obs if self.obs.enabled else None
+        r_no = self.round_no
+        self._last_admitted = 0
+        self._trace_rids.clear()
+        if o:
+            o.flight.mark(r_no, obs_mod.SUBMITTED, t_round)
 
         # -- -1. tenant lifecycle admin ops (rare; round-boundary surgery)
         if self._admin_q:
@@ -1322,6 +1408,8 @@ class MultiEngine:
         ph = self.phase_s
         t_ph = time.perf_counter()
         ph["stage"] = ph.get("stage", 0.0) + (t_ph - t_round)
+        if o:
+            o.h_phase["stage"].observe(t_ph - t_round)
 
         # -- 2. the kernel round (fused step + routing: one ASYNC
         # dispatch; jax queues it and returns immediately) ----------------
@@ -1340,7 +1428,8 @@ class MultiEngine:
         self.st = st
         self.inbox = inbox
         t_now = time.perf_counter()
-        ph["dispatch"] = ph.get("dispatch", 0.0) + (t_now - t_ph)
+        d_dispatch = t_now - t_ph
+        ph["dispatch"] = ph.get("dispatch", 0.0) + d_dispatch
         t_ph = t_now
 
         # -- 3. read back round k (blocks until the device finishes; the
@@ -1351,6 +1440,8 @@ class MultiEngine:
         # more rows than the cap take the full readback below. ----------
         rec = None
         need_host = None
+        d_readback = d_record = 0.0
+        t_stepped = t_ph
         if self._compact:
             # Check the 1-byte attestation BEFORE pulling the flag map:
             # need-host/post-surgery rounds take the full readback anyway
@@ -1358,13 +1449,15 @@ class MultiEngine:
             if not bool(anh_d) and not self._force_full:
                 flags_np = np.asarray(flags_d)
                 t_now = time.perf_counter()
-                ph["readback"] = ph.get("readback", 0.0) + (t_now - t_ph)
-                t_ph = t_now
+                d_readback = t_now - t_ph
+                ph["readback"] = ph.get("readback", 0.0) + d_readback
+                t_ph = t_stepped = t_now
                 rec = self._compact_record_admit(flags_np, staged_gs,
                                                  staged_ss)
                 if rec is not None:
                     t_now = time.perf_counter()
-                    ph["record"] = ph.get("record", 0.0) + (t_now - t_ph)
+                    d_record = t_now - t_ph
+                    ph["record"] = ph.get("record", 0.0) + d_record
                     t_ph = t_now
         if rec is None:
             (term, vote, commit, state, last, ring, need_host) = (
@@ -1373,8 +1466,9 @@ class MultiEngine:
                     (st.term, st.vote, st.commit, st.state,
                      st.last_index, st.log_term, st.need_host)))
             t_now = time.perf_counter()
-            ph["readback"] = ph.get("readback", 0.0) + (t_now - t_ph)
-            t_ph = t_now
+            d_readback = t_now - t_ph
+            ph["readback"] = ph.get("readback", 0.0) + d_readback
+            t_ph = t_stepped = t_now
 
             # Violation check FIRST — before this round's WAL append,
             # applies, or acks: a flagged round's commits come from state
@@ -1451,7 +1545,8 @@ class MultiEngine:
             self.h_state, self.h_last, self.h_ring = state, last, ring
             self._force_full = False   # mirrors == device state again
             t_now = time.perf_counter()
-            ph["record"] = ph.get("record", 0.0) + (t_now - t_ph)
+            d_record = t_now - t_ph
+            ph["record"] = ph.get("record", 0.0) + d_record
             t_ph = t_now
 
         # -- 6. persist, then apply+ack. WAL fsync strictly precedes the
@@ -1467,6 +1562,14 @@ class MultiEngine:
         # path: applying a conf performs device-state surgery that must
         # precede the next dispatch, so the record is appended+fsynced
         # before the inline apply below (append_sync).
+        if o:
+            o.h_phase["dispatch"].observe(d_dispatch)
+            o.h_phase["readback"].observe(d_readback)
+            o.h_step.observe(d_dispatch + d_readback)
+            o.h_phase["record"].observe(d_record)
+            o.flight.mark(r_no, obs_mod.STEPPED, t_stepped)
+            if self._staged:
+                o.h_batch.observe(self._last_admitted)
         rec.confs.extend(self._collect_committed_confs())
         sync_round = bool(rec.confs or self._confs_outstanding
                           or not self.cfg.pipeline_applies)
@@ -1478,12 +1581,25 @@ class MultiEngine:
                 self.wal.submit(rec)
             ph["wal_submit"] = ph.get("wal_submit", 0.0) + \
                 (time.perf_counter() - t0)
+            if o:
+                o.h_phase["wal_submit"].observe(time.perf_counter() - t0)
+                o.flight.mark(r_no, obs_mod.WAL_SUBMITTED)
+            tr = self.obs.tracer
+            if tr.every and self._trace_rids:
+                for rid in self._trace_rids:
+                    tr.mark(rid, "wal_submit", ticket=self.wal.ticket)
             self._recent_recs.append(rec)
         if sync_round:
             self._drain_applies()
             t0 = time.perf_counter()
+            a0 = self._acks.acked
             self._apply_committed(trigger=True)
             ph["apply"] = ph.get("apply", 0.0) + (time.perf_counter() - t0)
+            if o:
+                o.flight.mark(r_no, obs_mod.APPLIED)
+                o.flight.mark(r_no, obs_mod.ACKED)
+                if self._acks.acked > a0:
+                    o.c_acked.inc(self._acks.acked - a0)
         else:
             self._enqueue_apply(self._commit_view())
 
@@ -1495,6 +1611,9 @@ class MultiEngine:
             self._service_need_host(need_host)
 
         ph["tail"] = ph.get("tail", 0.0) + (time.perf_counter() - t_ph)
+        if o:
+            o.h_phase["tail"].observe(time.perf_counter() - t_ph)
+            o.c_rounds.inc()
         self.round_no += 1
         if (self.cfg.mask_check_rounds
                 and self.round_no % self.cfg.mask_check_rounds == 0):
@@ -1517,6 +1636,8 @@ class MultiEngine:
         compact-readback tails; iteration order is self._staged's
         insertion order, which both tails' scalar lists follow."""
         requeue: List[Tuple[int, List[Tuple[int, bytes]]]] = []
+        tr = self.obs.tracer
+        n_admitted = 0
         for (g, (_, ents)), admitted, t, base in zip(
                 self._staged.items(), adm_l, t_l, base_l):
             for j, items in enumerate(ents):
@@ -1528,11 +1649,19 @@ class MultiEngine:
                         reqs = [it[2] for it in items]
                         if None not in reqs:
                             self.payload_reqs[(g, i, t)] = reqs
+                    n_admitted += len(items)
+                    if tr.every:
+                        for it in items:
+                            if tr.sampled(it[0]):
+                                tr.mark(it[0], "admitted", g=g,
+                                        round=rec.round_no)
+                                self._trace_rids.append(it[0])
                     rec.entries.append((g, i, t, payload))
                 else:
                     requeue.append(
                         (g, [it for e in ents[j:] for it in e]))
                     break
+        self._last_admitted = n_admitted
         if requeue:
             with self._lock:
                 for g, rest in requeue:
@@ -1688,6 +1817,7 @@ class MultiEngine:
         into it instead of fired inline — the worker releases them after
         the view's durability ticket clears the WAL watermark."""
         W = self.cfg.window
+        tr = self.obs.tracer
         if acct is None:
             acct = self._acks
         if view is None:
@@ -1742,6 +1872,12 @@ class MultiEngine:
                         else:
                             reqs = [Request.decode(b)
                                     for b in _unpack_multi(payload)]
+                    if not trigger and tr.every:
+                        # Restart replay: sampled rids ride the durable
+                        # Request payloads, so the trace picks them back
+                        # up in the new process.
+                        for r0 in reqs:
+                            tr.mark(r0.id, "replayed", g=g)
                     # Batched fast path: runs of plain-file PUTs with no
                     # conditions and no TTL apply through ONE
                     # GIL-releasing C call per run
@@ -1787,6 +1923,8 @@ class MultiEngine:
                         except errors.EtcdError as err:
                             result = err
                         if trigger:
+                            if tr.every:
+                                tr.mark(r.id, "applied")
                             if sink is not None:
                                 if r.method != METHOD_SYNC:
                                     sink.acked += 1
@@ -1795,6 +1933,8 @@ class MultiEngine:
                                 if r.method != METHOD_SYNC:
                                     acct.acked += 1
                                 self.wait.trigger(r.id, result)
+                                if tr.every:
+                                    tr.mark(r.id, "acked")
                     if fp:
                         self._flush_many(st, fp, fv, fneed, frids,
                                          trigger, acct, sink)
@@ -1832,6 +1972,7 @@ class MultiEngine:
         now = st.clock()
         _, descs = st.set_applied_many(fp, fv, need=fneed)
         if trigger:
+            tr = self.obs.tracer
             if sink is not None:
                 sink.acked += len(fp)
             else:
@@ -1843,10 +1984,14 @@ class MultiEngine:
                                                 index=idx)
                 else:
                     res = LazyWriteEvent(nd, pd, idx, now)
+                if tr.every:
+                    tr.mark(rid, "applied")
                 if sink is not None:
                     sink.items.append((rid, res))
                 else:
                     self.wait.trigger(rid, res)
+                    if tr.every:
+                        tr.mark(rid, "acked")
 
     def _apply_request(self, g: int, r: Request):
         """Deterministic request->store mapping (reference applyRequest
@@ -2039,6 +2184,10 @@ class MultiEngine:
         log.critical("engine: CONSENSUS SAFETY VIOLATION in groups %s "
                      "(conflict at/below commit); state dumped to %s",
                      flagged, path)
+        # Flight-recorder auto-dump: the last <ring> rounds' stage
+        # timeline, beside the state dump.
+        self.obs.flight.dump(self.cfg.data_dir,
+                             f"violation-{self.round_no:016x}")
         raise EngineViolation(
             f"conflict at/below commit in groups {flagged}; dump: {path}")
 

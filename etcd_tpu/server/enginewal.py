@@ -33,6 +33,7 @@ import base64
 import json
 import os
 import struct
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -40,6 +41,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from etcd_tpu.utils.fileutil import fsync_dir, touch_dir_all
+from etcd_tpu.utils import metrics
 
 _HDR = struct.Struct("<IIQ")  # type, crc, len
 
@@ -234,15 +236,22 @@ class EngineWAL:
     def sync(self) -> None:
         """Flush + (optionally) fsync everything appended so far, then
         rotate if the segment is over size. After this returns, every
-        append_nosync'd record is durable and last_round reflects it."""
+        append_nosync'd record is durable and last_round reflects it.
+        Feeds the reference wal/metrics.go series (fsync latency in µs,
+        last index saved — here: last round) alongside the engine's own
+        per-shard histograms in walwriter.py."""
         if self._f is None:
             return
+        t0 = time.perf_counter()
         self._f.flush()
         if self.fsync:
             os.fsync(self._f.fileno())
+        metrics.wal_fsync_durations.observe(
+            (time.perf_counter() - t0) * 1e6)
         if self._pending_round >= 0:
             self.last_round = max(self.last_round, self._pending_round)
             self._pending_round = -1
+            metrics.wal_last_index_saved.set(self.last_round)
         if self._f.tell() >= self.segment_size:
             self._open_segment(self.last_round + 1)
             self._f.flush()

@@ -66,7 +66,7 @@ from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      Request)
 from etcd_tpu.store import new_store
 from etcd_tpu.store.event import LazyWriteEvent
-from etcd_tpu.utils import idutil
+from etcd_tpu.utils import idutil, metrics
 from etcd_tpu.utils.wait import Wait
 
 log = logging.getLogger("etcd_tpu.hostengine")
@@ -847,13 +847,20 @@ class HostEngine:
             self._pending[g].append((r.id, payload))
             self._dirty.add(g)
         import queue as _q
+        t0 = time.perf_counter()
+        metrics.propose_pending.inc()
         try:
             result = q.get(timeout=timeout or self.cfg.request_timeout)
         except _q.Empty:
             self.wait.cancel(r.id)
+            metrics.propose_failed.inc()
             raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
                                    cause="request timed out",
                                    index=int(self.applied[g]))
+        finally:
+            metrics.propose_pending.dec()
+        metrics.propose_durations.observe(
+            (time.perf_counter() - t0) * 1000.0)
         if isinstance(result, errors.EtcdError):
             raise result
         if type(result) is LazyWriteEvent:

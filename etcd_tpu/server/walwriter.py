@@ -50,6 +50,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from etcd_tpu.server.enginewal import EngineWAL, RoundRecord
+from etcd_tpu.server.obs import DURABLE as _FLIGHT_DURABLE
 
 _STATS_WINDOW = 4096   # per-shard rolling sample window for stats()
 
@@ -129,12 +130,17 @@ class WALWriter:
     def __init__(self, dirname: str, groups: int, shards: int = 1,
                  segment_size: int = 64 * 1024 * 1024,
                  fsync: bool = True, queue_rounds: int = 64,
-                 phase_s: Optional[Dict[str, float]] = None) -> None:
+                 phase_s: Optional[Dict[str, float]] = None,
+                 obs=None) -> None:
         self.dir = dirname
         self.groups = groups
         self.fsync = fsync
         self.queue_rounds = max(1, queue_rounds)
         self.phase_s = phase_s if phase_s is not None else {}
+        # Observability plane (obs.EngineObs): per-shard fsync/group-
+        # commit histograms, queue-depth + watermark-lag gauges, flight
+        # recorder durable marks. None (or disabled) = zero overhead.
+        self._obs = obs if (obs is not None and obs.enabled) else None
         S = max(1, min(shards, groups))
         # Root stream: THE stream at S=1 (byte-compatible with the
         # pre-compartment layout), checkpoint store + frozen legacy
@@ -229,6 +235,11 @@ class WALWriter:
                     sh.wal.append_nosync(RoundRecord(round_no=top_round))
                 sh.wal.sync()       # ONE fsync covers the whole batch
             except Exception as e:  # noqa: BLE001 — re-raised at the seam
+                if self._obs is not None:
+                    # A writer-shard fail-stop kills the whole
+                    # durability pipeline: dump the round timeline.
+                    self._obs.flight.dump(self.dir,
+                                          f"wal-shard-{sh.idx}")
                 with sh.cv:
                     sh.exc = e
                     sh.cv.notify_all()
@@ -240,12 +251,20 @@ class WALWriter:
             sh.fsyncs += 1
             sh.fsync_ms.append(dt * 1000.0)
             sh.batch_sizes.append(len(batch))
+            ob = self._obs
+            if ob is not None:
+                ob.h_wal_fsync[sh.idx].observe(dt)
+                ob.h_wal_commit[sh.idx].observe(len(batch))
+                for _t, rnd, _sub in batch:
+                    ob.flight.mark(rnd, _FLIGHT_DURABLE)
             with self._wm:
                 sh.durable = top_ticket
                 d = min(s.durable for s in self.shards)
                 if d > self._durable:
                     self._durable = d
                     self._wm.notify_all()
+            if ob is not None:
+                ob.g_wal_lag.set(self._last_ticket - self._durable)
 
     def submit(self, rec: RoundRecord) -> int:
         """Queue one round's record for durability and return its ticket
@@ -266,6 +285,8 @@ class WALWriter:
                 if sh.exc is None:
                     sh.q.append((ticket, rec.round_no, sub))
                     self._depths.append(len(sh.q))
+                    if self._obs is not None:
+                        self._obs.g_wal_queue[sh.idx].set(len(sh.q))
                     sh.cv.notify_all()
         self._raise_exc()
         self._submitted += 1

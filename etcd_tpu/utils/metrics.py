@@ -8,6 +8,7 @@ format at /metrics. Pure stdlib; thread-safe.
 """
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 from collections import deque
@@ -83,6 +84,136 @@ class Gauge(_Metric):
 
     def samples(self):
         return [(self.name, {}, self.value)]
+
+
+class Histogram(_Metric):
+    """A bucketed Prometheus histogram (`*_bucket{le=...}` + sum/count).
+
+    Lock-light by design: observe() is two integer adds and a float add
+    on thread-confined-or-GIL-serialized cells — no mutex on the hot
+    path (the engine's round loop and writer/applier workers observe
+    from their own threads at pipeline rate; the standard client's
+    per-observation mutex is exactly the overhead the instrumentation
+    A/B gate exists to forbid). Under CPython's GIL a concurrent
+    increment can at worst lose single counts (never tear, never go
+    backwards), which is inside monitoring noise; exposition derives
+    `_count` from the bucket cells themselves so a scrape is always
+    internally consistent (cumulative buckets monotone, +Inf == count).
+    """
+
+    kind = "histogram"
+
+    # The prometheus client's DefBuckets, in seconds — fits both the
+    # sub-ms engine phases and multi-ms fsyncs.
+    DEFAULT = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+               0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = DEFAULT,
+                 registry=None) -> None:
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)   # +Inf tail cell
+        self._sum = 0.0
+        super().__init__(name, help_, registry)
+
+    def observe(self, v: float) -> None:
+        # bisect over a small tuple beats a Python loop; no lock (see
+        # class docstring).
+        i = bisect.bisect_left(self.buckets, v)
+        self._counts[i] += 1
+        self._sum += v
+
+    @property
+    def count(self) -> int:
+        return sum(self._counts)
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def samples(self):
+        counts = list(self._counts)      # one snapshot, used throughout
+        out = []
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out.append((self.name + "_bucket", {"le": repr(float(b))}, cum))
+        cum += counts[-1]
+        out.append((self.name + "_bucket", {"le": "+Inf"}, cum))
+        out.append((self.name + "_sum", {}, self._sum))
+        out.append((self.name + "_count", {}, cum))
+        return out
+
+
+class LabeledHistogram(_Metric):
+    """A histogram vector keyed by one or more labels (e.g. the engine's
+    per-compartment shard index, reference wal/snap metrics.go shape)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str],
+                 buckets: Sequence[float] = Histogram.DEFAULT,
+                 registry=None) -> None:
+        self.label_names = tuple(label_names)
+        self._buckets = buckets
+        self._children: Dict[Tuple[str, ...], Histogram] = {}
+        super().__init__(name, help_, registry)
+
+    def labels(self, *values) -> Histogram:
+        key = tuple(str(v) for v in values)
+        h = self._children.get(key)
+        if h is None:
+            with self._lock:
+                h = self._children.get(key)
+                if h is None:
+                    h = Histogram(self.name, self.help, self._buckets,
+                                  registry=UNREGISTERED)
+                    self._children[key] = h
+        return h
+
+    def samples(self):
+        out = []
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            lbls = dict(zip(self.label_names, key))
+            for name, extra, v in child.samples():
+                out.append((name, {**lbls, **extra}, v))
+        return out
+
+
+class LabeledGauge(_Metric):
+    """A gauge vector keyed by one or more labels (per-shard queue depths
+    and watermarks)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str],
+                 registry=None) -> None:
+        self.label_names = tuple(label_names)
+        self._children: Dict[Tuple[str, ...], Gauge] = {}
+        super().__init__(name, help_, registry)
+
+    def labels(self, *values) -> Gauge:
+        key = tuple(str(v) for v in values)
+        g = self._children.get(key)
+        if g is None:
+            with self._lock:
+                g = self._children.get(key)
+                if g is None:
+                    g = Gauge(self.name, self.help, registry=UNREGISTERED)
+                    self._children[key] = g
+        return g
+
+    def samples(self):
+        out = []
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            lbls = dict(zip(self.label_names, key))
+            for name, extra, v in child.samples():
+                out.append((name, {**lbls, **extra}, v))
+        return out
 
 
 class Summary(_Metric):
@@ -203,26 +334,55 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    @staticmethod
+    def _escape_label(val: str) -> str:
+        """Text exposition format: label values escape backslash,
+        double-quote, and line feed (in that order — backslash first so
+        the escapes themselves survive)."""
+        return (str(val).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    @staticmethod
+    def _series_name(name: str, labels: Dict[str, str]) -> str:
+        if not labels:
+            return name
+        lbl = ",".join(f'{k}="{Registry._escape_label(val)}"'
+                       for k, val in sorted(labels.items()))
+        return f"{name}{{{lbl}}}"
+
     def expose(self) -> str:
         """Prometheus text exposition format."""
         lines: List[str] = []
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         for m in metrics:
-            lines.append(f"# HELP {m.name} {m.help}")
+            # HELP text escapes backslash and line feed (no quote escape).
+            help_ = m.help.replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {m.name} {help_}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for name, labels, v in m.samples():
-                if labels:
-                    lbl = ",".join(f'{k}="{val}"'
-                                   for k, val in sorted(labels.items()))
-                    series = f"{name}{{{lbl}}}"
-                else:
-                    series = name
+                series = self._series_name(name, labels)
                 if isinstance(v, float) and math.isnan(v):
                     lines.append(f"{series} NaN")
                 else:
                     lines.append(f"{series} {v}")
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat {series-with-labels: value} map of every finite sample.
+
+        The bench uses before/after snapshots of this to cross-check its
+        own BENCH columns against what /metrics would have reported.
+        """
+        out: Dict[str, float] = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            for name, labels, v in m.samples():
+                if isinstance(v, float) and math.isnan(v):
+                    continue
+                out[self._series_name(name, labels)] = float(v)
+        return out
 
 
 REGISTRY = Registry()

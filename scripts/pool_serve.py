@@ -51,6 +51,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from etcd_tpu.tools.functional_tester import _free_ports  # noqa: E402
+from etcd_tpu.server.obs import pool_router_requests  # noqa: E402
+from etcd_tpu.utils.metrics import REGISTRY, fd_usage  # noqa: E402
 
 
 def make_router(groups: int, per_shard: int, shard_ports):
@@ -81,15 +83,38 @@ def make_router(groups: int, per_shard: int, shard_ports):
                 return 0, self.path
             return -1, self.path
 
+        def _metrics(self):
+            used, limit = fd_usage()
+            body = (REGISTRY.expose()
+                    + "# HELP process_open_fds Number of open file "
+                      "descriptors.\n"
+                      "# TYPE process_open_fds gauge\n"
+                      f"process_open_fds {float(used)}\n"
+                      "# HELP process_max_fds Maximum number of open "
+                      "file descriptors.\n"
+                      "# TYPE process_max_fds gauge\n"
+                      f"process_max_fds {float(limit)}\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def _proxy(self):
+            if self.path == "/metrics" and self.command == "GET":
+                self._metrics()
+                return
             s, path = self._route()
             if path is None:
+                pool_router_requests.labels("none").inc()
                 self.send_error(404, "unknown tenant")
                 return
             if s == -1:
+                pool_router_requests.labels("none").inc()
                 self.send_error(
                     501, "pool router serves per-tenant paths only")
                 return
+            pool_router_requests.labels(str(s)).inc()
             body = None
             ln = self.headers.get("Content-Length")
             if ln:

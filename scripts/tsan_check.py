@@ -33,7 +33,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = r"""
 import sys, threading
 sys.path.insert(0, sys.argv[1])
+sys.path.insert(1, sys.argv[2])
 import storecore, walcodec
+from etcd_tpu.utils.metrics import Histogram, Registry
+from etcd_tpu.server.obs import FlightRecorder, SUBMITTED, ACKED
 
 c = storecore.Core(("/0", "/1"))
 thread_errors = []
@@ -154,6 +157,43 @@ def wal_waiter():
                 wm.wait(10)
         assert min(wal_durable) >= t
 
+# Observability-plane shapes (obs.py): the lock-light histogram's
+# observe() is two plain increments racing a scraper's samples() pass,
+# and the flight ring's SUBMITTED mark rebinds whole rows under readers
+# walking to_trace_events(). Deliberately tolerant contracts — lost
+# single counts, dropped late marks — but NEVER a torn exposition
+# (cumulative buckets must stay monotone within one samples() pass)
+# and never a mixed-round row (rebind is whole-object).
+obs_hist = Histogram("tsan_obs_seconds", "tsan", registry=Registry())
+HIST_N, HIST_T = 5000, 4
+
+def hist_observer(tid):
+    for i in range(HIST_N):
+        obs_hist.observe((tid + 1) * 1e-4 * (1 + (i & 15)))
+
+def hist_scraper():
+    for _ in range(2000):
+        rows = obs_hist.samples()
+        cum = -1.0
+        for name, labels, v in rows:
+            if name.endswith("_bucket"):
+                assert v >= cum, "torn exposition: buckets not monotone"
+                cum = v
+
+flight = FlightRecorder(capacity=64)
+FLIGHT_N = 20000
+
+def flight_submitter():
+    for rnd in range(FLIGHT_N):
+        flight.mark(rnd, SUBMITTED)
+        flight.mark(rnd - 3, ACKED)   # late mark racing the wrap
+
+def flight_reader():
+    for _ in range(300):
+        for ev in flight.to_trace_events()["traceEvents"]:
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0, ev
+
 ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
       + [threading.Thread(target=reader), threading.Thread(target=codec)]
       + [threading.Thread(target=shard_applier, args=(shards[k], k))
@@ -163,7 +203,12 @@ ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
       + [threading.Thread(target=wal_writer, args=(k,))
          for k in range(WS)]
       + [threading.Thread(target=wal_submitter),
-         threading.Thread(target=wal_waiter)])
+         threading.Thread(target=wal_waiter)]
+      + [threading.Thread(target=hist_observer, args=(t,))
+         for t in range(HIST_T)]
+      + [threading.Thread(target=hist_scraper),
+         threading.Thread(target=flight_submitter),
+         threading.Thread(target=flight_reader)])
 for t in ts:
     t.start()
 for t in ts:
@@ -172,6 +217,13 @@ if thread_errors:
     print("TSAN-CHILD-THREAD-ERRORS:", thread_errors[:3])
     sys.exit(3)
 assert min(wal_durable) == WAL_TICKETS, wal_durable
+# Lock-light loss bound: single counts may drop under the race, but
+# the cells are monotone — never MORE than observed, and a total wipe
+# would mean the increments aliased, not raced.
+assert 0 < obs_hist.count <= HIST_N * HIST_T, obs_hist.count
+rows = [r for r in flight.snapshot() if r[0] >= 0]
+assert len(rows) == flight.capacity, len(rows)
+assert all(r[0] < FLIGHT_N for r in rows)
 first, last, failed, recs, descs = c.set_many(
     ["/1/b%d" % i for i in range(200)], ["v"] * 200, 2.0, False)
 assert failed == 0 and last - first == 199 and descs is None
@@ -224,7 +276,7 @@ def main() -> int:
         env = dict(os.environ, LD_PRELOAD=libtsan,
                    TSAN_OPTIONS="halt_on_error=0 exitcode=66")
         r = subprocess.run(
-            [sys.executable, "-c", CHILD, tmp],
+            [sys.executable, "-c", CHILD, tmp, REPO],
             capture_output=True, text=True, env=env, timeout=300)
         out = r.stdout + r.stderr
         warnings = out.count("WARNING: ThreadSanitizer")
@@ -238,7 +290,8 @@ def main() -> int:
           "ThreadSanitizer (4 writers + reader + codec threads, 4 shard "
           "appliers via set_many(need=...), 2 same-core set_many "
           "contenders + reader, 3 WAL-writer streams + submitter + "
-          "watermark waiter)")
+          "watermark waiter, 4 histogram observers vs scraper + flight "
+          "ring submitter vs trace reader)")
     return 0
 
 

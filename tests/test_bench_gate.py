@@ -172,3 +172,36 @@ def test_gate_wal_shards_change_not_comparable(tmp_path, capsys):
     out = capsys.readouterr()
     assert "PERF REGRESSION" not in out.err
     assert "not comparable" in out.err
+
+
+# -- round-8 instrumentation-overhead guard ----------------------------------
+
+def test_gate_flags_obs_overhead_over_budget(tmp_path, capsys):
+    """The observability plane's interleaved A/B (BENCH_OBS_AB) reports
+    obs_overhead_pct; anything past the 3% budget is flagged — an
+    ABSOLUTE budget, not a vs-previous-artifact comparison (the A/B
+    already carries its own baseline side)."""
+    bench = _load_bench()
+    prev = _mk_artifact(tmp_path, _BASE)
+    cur = dict(_BASE, obs_overhead_pct=4.7)
+    bench._regression_gate(_cur_line(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" in out.err
+    emitted = json.loads(out.out.strip().splitlines()[-1])
+    flagged = {f["scenario"]: f for f in emitted["perf_regressions"]}
+    assert flagged == {"engine.obs_overhead_pct": flagged[
+        "engine.obs_overhead_pct"]}
+    fl = flagged["engine.obs_overhead_pct"]
+    assert fl["now"] == 4.7 and fl["prev_artifact"] == "obs-overhead-budget"
+
+
+def test_gate_obs_overhead_within_budget_silent(tmp_path, capsys):
+    bench = _load_bench()
+    prev = _mk_artifact(tmp_path, _BASE)
+    bench._regression_gate(_cur_line(prev, dict(_BASE,
+                                                obs_overhead_pct=1.2)),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert not out.out.strip()

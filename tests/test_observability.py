@@ -1,0 +1,410 @@
+"""The pipeline observability plane (server/obs.py, utils/metrics.py):
+
+- /metrics exposes every compartment's histograms and gauges (round
+  loop, WAL writer shards, applier shards, ack gate) and stays
+  un-torn and monotone under concurrent deep-queue writes — verified
+  at the HTTP level through the same parser etcd_top uses.
+- The registry's acked-requests counter moves by EXACTLY the number of
+  writes the engine reports acked (the differential cross-check the
+  bench's metrics_delta column relies on).
+- The flight recorder ring wraps without mixing rounds, drops late
+  marks for evicted rounds, and its SIGUSR2 dump is valid Chrome
+  trace-event JSON carrying all six pipeline stages.
+- Sampled trace ids ride the durable WAL payloads: a SIGKILL'd engine's
+  acked writes come back as `replayed` trace spans in the restarted
+  process.
+"""
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from etcd_tpu.server import obs as obs_mod                     # noqa: E402
+from etcd_tpu.utils import metrics                             # noqa: E402
+
+G, P = 6, 3
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_for_obs_test", os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- unit: histogram + exposition escaping -----------------------------------
+
+
+def test_histogram_buckets_cumulative_and_consistent():
+    reg = metrics.Registry()
+    h = metrics.Histogram("t_hist_seconds", "t", buckets=(0.01, 0.1, 1.0),
+                          registry=reg)
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    rows = {(n, tuple(sorted(lab.items()))): v
+            for n, lab, v in h.samples()}
+    assert rows[("t_hist_seconds_bucket", (("le", "0.01"),))] == 2
+    assert rows[("t_hist_seconds_bucket", (("le", "0.1"),))] == 3
+    assert rows[("t_hist_seconds_bucket", (("le", "1.0"),))] == 4
+    assert rows[("t_hist_seconds_bucket", (("le", "+Inf"),))] == 5
+    assert rows[("t_hist_seconds_count", ())] == 5
+    assert abs(rows[("t_hist_seconds_sum", ())] - 5.56) < 1e-9
+    # The labeled variant keeps per-child series under one family.
+    lh = metrics.LabeledHistogram("t_lab_seconds", "t", ("shard",),
+                                  buckets=(1.0,), registry=reg)
+    lh.labels("0").observe(0.5)
+    lh.labels("1").observe(2.0)
+    text = reg.expose()
+    assert 't_lab_seconds_bucket{le="1.0",shard="0"} 1' in text
+    assert 't_lab_seconds_bucket{le="+Inf",shard="1"} 1' in text
+    assert text.count("# TYPE t_lab_seconds histogram") == 1
+
+
+def test_expose_escapes_label_values_roundtrip():
+    """Satellite fix: backslash, double-quote, and newline in a label
+    value must be escaped per the text exposition format — and round-
+    trip back through a conforming parser (etcd_top's)."""
+    reg = metrics.Registry()
+    c = metrics.LabeledCounter("t_esc_total", 'help with "quotes"\nand\\',
+                               ("path",), registry=reg)
+    evil = 'a\\b"c\nd'
+    c.labels(evil).inc(3)
+    text = reg.expose()
+    assert 'path="a\\\\b\\"c\\nd"' in text
+    # HELP escapes backslash + newline (no quote escaping there).
+    assert '# HELP t_esc_total help with "quotes"\\nand\\\\' in text
+    parsed = _load_script("etcd_top").parse_metrics(text)
+    assert parsed[("t_esc_total", (("path", evil),))] == 3.0
+
+
+def test_etcd_top_quantiles_and_render():
+    top = _load_script("etcd_top")
+    prev = {("h_bucket", (("le", "0.1"),)): 0.0,
+            ("h_bucket", (("le", "+Inf"),)): 0.0,
+            ("h_count", ()): 0.0, ("h_sum", ()): 0.0,
+            ("etcd_engine_rounds_total", ()): 10.0}
+    cur = {("h_bucket", (("le", "0.1"),)): 90.0,
+           ("h_bucket", (("le", "+Inf"),)): 100.0,
+           ("h_count", ()): 100.0, ("h_sum", ()): 5.0,
+           ("etcd_engine_rounds_total", ()): 30.0}
+    buckets, total, dsum = top.hist_delta(prev, cur, "h")
+    assert total == 100.0 and dsum == 5.0
+    assert top.quantile(buckets, total, 0.5) == 0.1
+    assert top.quantile(buckets, total, 0.99) == float("inf")
+    assert top.counter_rate(prev, cur, "etcd_engine_rounds_total",
+                            2.0) == 10.0
+    frame = top.render(prev, cur, 2.0)
+    assert any("rounds/s" in ln for ln in frame)
+
+
+# -- unit: flight recorder ----------------------------------------------------
+
+
+def test_flight_ring_wraparound_drops_late_marks():
+    fl = obs_mod.FlightRecorder(capacity=16)
+    base = 1000.0
+    for rnd in range(40):
+        for st in range(6):
+            fl.mark(rnd, st, base + rnd + st * 0.01)
+    rows = fl.snapshot()
+    live = sorted(r[0] for r in rows if r[0] >= 0)
+    assert live == list(range(24, 40))            # last 16 rounds only
+    # A late mark for an evicted round must be DROPPED, not written
+    # into whatever round now owns the slot.
+    fl.mark(3, obs_mod.ACKED, 9999.0)
+    row19 = next(r for r in fl.snapshot() if r[0] == 3 + 16 * 2)
+    assert 9999.0 not in row19
+    # Every surviving row is internally one round: stages ascend.
+    for r in fl.snapshot():
+        stamps = [r[1 + k] for k in range(6) if r[1 + k] > 0]
+        assert stamps == sorted(stamps)
+
+
+def test_flight_dump_is_chrome_trace_json(tmp_path):
+    fl = obs_mod.FlightRecorder(capacity=32)
+    for rnd in range(8):
+        for st in range(6):
+            fl.mark(rnd, st, 5.0 + rnd * 0.1 + st * 0.001)
+    path = fl.dump(str(tmp_path), "golden")
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs if e["ph"] == "i"}
+    assert names == set(obs_mod.STAGE_NAMES)
+    spans = {e["name"] for e in evs if e["ph"] == "X"}
+    assert f"{obs_mod.STAGE_NAMES[0]}->{obs_mod.STAGE_NAMES[1]}" in spans
+    for e in evs:
+        assert e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] > 0
+
+
+def test_obs_disabled_master_switch(monkeypatch):
+    monkeypatch.setenv("ETCD_TPU_OBS", "off")
+    eo = obs_mod.EngineObs(wal_shards=2, applier_shards=2)
+    assert not eo.enabled and not eo.flight.enabled
+    fl = obs_mod.FlightRecorder(capacity=16)
+    fl.mark(1, obs_mod.SUBMITTED, 1.0)
+    assert all(r[0] == -1 for r in fl.snapshot())
+
+
+# -- engine-level: /metrics over HTTP under concurrent load ------------------
+
+
+@pytest.fixture(scope="module")
+def eng_http():
+    prev = os.environ.get("ETCD_TPU_TRACE_EVERY")
+    os.environ["ETCD_TPU_TRACE_EVERY"] = "2"
+    from etcd_tpu.etcdhttp.tenants import EngineHttp
+    from etcd_tpu.server.engine import EngineConfig, MultiEngine
+    tmp = tempfile.mkdtemp(prefix="obs-test-")
+    eng = MultiEngine(EngineConfig(
+        groups=G, peers=P, data_dir=tmp, window=16, max_ents=4,
+        heartbeat_tick=3, fsync=False, checkpoint_rounds=1 << 30,
+        applier_shards=2, wal_shards=2, request_timeout=60.0))
+    eng.start()
+    assert eng.wait_leaders(180), f"no leaders: {eng.failed}"
+    front = EngineHttp(eng, port=0)
+    front.start()
+    try:
+        yield eng, front.url.rstrip("/")
+    finally:
+        front.stop()
+        eng.stop()
+        if prev is None:
+            os.environ.pop("ETCD_TPU_TRACE_EVERY", None)
+        else:
+            os.environ["ETCD_TPU_TRACE_EVERY"] = prev
+
+
+def _http(method, url, body=None):
+    req = urllib.request.Request(
+        url, method=method, data=body.encode() if body else None)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read().decode()
+
+
+def test_metrics_http_all_compartments_under_load(eng_http):
+    """The acceptance surface: all four compartments' series on
+    /metrics, scraped CONCURRENTLY with deep-queue writes — every
+    scrape parses, histograms are internally consistent (+Inf bucket
+    == _count), and counters never move backwards between scrapes."""
+    eng, base = eng_http
+    top = _load_script("etcd_top")
+    stop = threading.Event()
+    errs = []
+
+    def writer(tid):
+        i = 0
+        try:
+            while not stop.is_set():
+                _http("PUT",
+                      f"{base}/tenants/{(tid + i) % G}/v2/keys/"
+                      f"obs/w{tid}-{i}", f"value=v{i}")
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    writers = [threading.Thread(target=writer, args=(t,))
+               for t in range(4)]
+    for t in writers:
+        t.start()
+    try:
+        scrapes = []
+        deadline = time.time() + 12
+        while time.time() < deadline and len(scrapes) < 6:
+            scrapes.append(top.parse_metrics(_http("GET",
+                                                   base + "/metrics")))
+            time.sleep(0.4)
+    finally:
+        stop.set()
+        for t in writers:
+            t.join()
+    assert not errs, errs
+    assert len(scrapes) >= 3
+
+    last = scrapes[-1]
+    names = {k[0] for k in last}
+    # Round-loop compartment.
+    assert "etcd_engine_round_phase_seconds_bucket" in names
+    assert "etcd_engine_kernel_step_seconds_bucket" in names
+    assert "etcd_engine_round_batch_requests_bucket" in names
+    phases = {dict(k[1]).get("phase") for k in last
+              if k[0] == "etcd_engine_round_phase_seconds_bucket"}
+    assert {"stage", "dispatch", "readback", "record", "wal_submit",
+            "tail"} <= phases
+    # WAL-writer compartment: per-shard fsync + queue depth + lag.
+    shards = {dict(k[1]).get("shard") for k in last
+              if k[0] == "etcd_wal_writer_fsync_seconds_bucket"}
+    # Superset, not equality: labeled children live in the process-global
+    # registry, so earlier test modules' engines (other shard counts) may
+    # have left extra labels behind.
+    assert {"0", "1"} <= shards
+    assert "etcd_wal_writer_queue_depth" in names
+    assert "etcd_wal_writer_watermark_lag_tickets" in names
+    assert "etcd_wal_writer_group_commit_rounds_bucket" in names
+    # Applier compartment + ack gate.
+    assert {"0", "1"} <= {dict(k[1]).get("shard") for k in last
+                          if k[0] == "etcd_applier_queue_depth"}
+    assert "etcd_applier_apply_batch_requests_bucket" in names
+    assert "etcd_ack_gate_wait_seconds_bucket" in names
+    # Reference proposal metrics (satellite wiring).
+    assert "etcd_server_proposal_durations_milliseconds_count" in names
+    assert "etcd_server_pending_proposal_total" in names
+    assert last[("etcd_server_proposal_durations_milliseconds_count",
+                 ())] > 0
+
+    # No torn exposition: within one scrape, +Inf == _count per family.
+    for fam in ("etcd_engine_kernel_step_seconds",
+                "etcd_ack_gate_wait_seconds"):
+        inf = sum(v for k, v in last.items()
+                  if k[0] == fam + "_bucket"
+                  and dict(k[1]).get("le") == "+Inf")
+        assert inf == last[(fam + "_count", ())]
+    # Monotone counters across consecutive scrapes.
+    for a, b in zip(scrapes, scrapes[1:]):
+        for key in ("etcd_engine_rounds_total",
+                    "etcd_engine_acked_requests_total",
+                    "etcd_server_proposal_durations_milliseconds_count"):
+            assert b[(key, ())] >= a[(key, ())]
+
+
+def test_acked_counter_differential(eng_http):
+    """Registry movement == engine-reported acks: the cross-check
+    bench.py's metrics_delta column institutionalizes."""
+    eng, base = eng_http
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_obs_test", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    snap0 = bench._metrics_snapshot()
+    a0 = eng.acked_requests
+    N = 12
+    for i in range(N):
+        _http("PUT", f"{base}/tenants/{i % G}/v2/keys/diff/k{i}",
+              f"value=v{i}")
+    delta = bench._metrics_delta(snap0, bench._metrics_snapshot())
+    moved = delta.get("etcd_engine_acked_requests_total", 0)
+    assert moved == N == eng.acked_requests - a0
+
+
+def test_flight_and_traces_http(eng_http):
+    eng, base = eng_http
+    for i in range(2 * G):
+        _http("PUT", f"{base}/tenants/{i % G}/v2/keys/fl/k{i}",
+              f"value=v{i}")
+    doc = json.loads(_http("GET", base + "/debug/flight"))
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "i"}
+    assert names == set(obs_mod.STAGE_NAMES)
+    tr = json.loads(_http("GET", base + "/debug/traces"))
+    assert tr["every"] == 2 and tr["spans"]
+    stages = set()
+    for s in tr["spans"]:
+        stages |= set(s["stages"])
+    assert {"submit", "admitted", "wal_submit", "durable", "applied",
+            "acked"} <= stages
+
+
+def test_sigusr2_dumps_flight_ring(eng_http):
+    eng, base = eng_http
+    diag = os.path.join(eng.cfg.data_dir, "diagnostics")
+    before = set(os.listdir(diag)) if os.path.isdir(diag) else set()
+    os.kill(os.getpid(), signal.SIGUSR2)
+    deadline = time.time() + 15
+    new = set()
+    while time.time() < deadline and not new:
+        now = set(os.listdir(diag)) if os.path.isdir(diag) else set()
+        new = {f for f in now - before if "sigusr2" in f}
+        time.sleep(0.1)
+    assert new, "SIGUSR2 produced no flight dump"
+    with open(os.path.join(diag, sorted(new)[-1])) as f:
+        doc = json.load(f)
+    assert {e["name"] for e in doc["traceEvents"]
+            if e["ph"] == "i"} == set(obs_mod.STAGE_NAMES)
+
+
+# -- trace ids survive SIGKILL + WAL replay ----------------------------------
+
+_TRACE_CRASH_CHILD = r"""
+import os, sys, tempfile
+os.environ["ETCD_TPU_TRACE_EVERY"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from etcd_tpu.server.engine import EngineConfig, MultiEngine
+from etcd_tpu.server.request import Request, METHOD_PUT
+
+d, ackpath = sys.argv[1], sys.argv[2]
+eng = MultiEngine(EngineConfig(
+    groups=4, peers=3, data_dir=d, window=16, max_ents=4,
+    heartbeat_tick=3, fsync=True, checkpoint_rounds=1 << 30,
+    applier_shards=2, wal_shards=2, request_timeout=60.0))
+eng.start()
+assert eng.wait_leaders(180), eng.failed
+ack = open(ackpath, "a")
+print("READY", flush=True)
+rid = 10_000
+while True:
+    r = Request(id=rid, method=METHOD_PUT,
+                path=f"/crash/k{rid}", val="v")
+    eng.do(rid % 4, r)            # returns only after durable ack
+    ack.write("%d\n" % rid)
+    ack.flush()
+    rid += 2
+"""
+
+
+def test_trace_ids_survive_sigkill_and_replay(tmp_path):
+    """Sampled rids ride the durable Request payloads: SIGKILL the
+    engine mid-stream, restart on the same data dir with tracing on,
+    and every acked rid must reappear as a `replayed` trace span."""
+    d = tmp_path / "crash"
+    ackpath = tmp_path / "acked.log"
+    ackpath.write_text("")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _TRACE_CRASH_CHILD, str(d), str(ackpath)],
+        stdout=subprocess.PIPE, cwd=REPO)
+    try:
+        assert proc.stdout.readline().strip() == b"READY"
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if len(ackpath.read_text().splitlines()) >= 6:
+                break
+            time.sleep(0.01)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    acked = [int(x) for x in ackpath.read_text().splitlines() if x]
+    assert len(acked) >= 6, "child never got going"
+
+    from etcd_tpu.server.engine import EngineConfig, MultiEngine
+    prev = os.environ.get("ETCD_TPU_TRACE_EVERY")
+    os.environ["ETCD_TPU_TRACE_EVERY"] = "1"
+    try:
+        eng = MultiEngine(EngineConfig(
+            groups=4, peers=3, data_dir=str(d), window=16, max_ents=4,
+            heartbeat_tick=3, fsync=False, checkpoint_rounds=1 << 30,
+            applier_shards=2, wal_shards=2))
+        spans = {s["rid"]: s["stages"] for s in eng.obs.tracer.spans()}
+        eng.stop()
+    finally:
+        if prev is None:
+            os.environ.pop("ETCD_TPU_TRACE_EVERY", None)
+        else:
+            os.environ["ETCD_TPU_TRACE_EVERY"] = prev
+    for rid in acked:
+        assert rid in spans, f"acked rid {rid} lost from replay trace"
+        assert "replayed" in spans[rid], spans[rid]
