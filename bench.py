@@ -38,12 +38,23 @@ Scenario matrix (BASELINE.json configs 3-5):
             machinery — end-to-end acked writes/s, the apples-to-apples
             line against the reference's 4,157 writes/s (which also pays
             fsync + apply per write)
+  qread   — the round-9 read plane: quorum reads through the zero-append
+            batched-ReadIndex path, A/B-interleaved against the same
+            reads driven down the propose path (METHOD_QGET), plus a
+            mixed read/write phase; the read-only leg measures the
+            zero-append claim as wal-byte / log-length deltas (both 0)
+  watch_storm — 100k+ stream watchers fed from the event-history ring
+            under concurrent writes: delivery throughput + p99 staleness
+  expiry_wave — every tenant's TTL keys expire at the same instant; the
+            sync scan stages SYNCs that sweep the TTL heaps through
+            consensus: expired keys/s + the round-loop stall the wave adds
 The primary metric is the uniform run; the other scenarios run in the
 remaining budget and report under "scenarios".
 
 Env knobs: BENCH_GROUPS, BENCH_PEERS (5), BENCH_ROUNDS, BENCH_WARM_ROUNDS,
-BENCH_BUDGET_S (480), BENCH_SCENARIO (all|uniform|zipf|lag|churn),
-BENCH_PLATFORM.
+BENCH_BUDGET_S (480), BENCH_SCENARIO (all|uniform|zipf|lag|churn|qread|
+watch_storm|expiry_wave), BENCH_PLATFORM, BENCH_QREAD_GROUPS,
+BENCH_WATCHERS, BENCH_WATCH_KEYS, BENCH_EXPIRY_GROUPS, BENCH_TTL_KEYS.
 """
 from __future__ import annotations
 
@@ -887,6 +898,491 @@ def child_main() -> int:
                 f"{out['obs_overhead_pct']}% ({pairs} interleaved pairs)")
         return out
 
+    def measure_qread(sc_deadline):
+        """Round-9 read plane A/B: quorum reads through the zero-append
+        batched-ReadIndex path vs the SAME reads driven down the propose
+        path (METHOD_QGET — a log entry per read, the pre-round-9
+        behavior), interleaved qget/qread/qget/qread on this same box so
+        slow drift cancels. The leading qread leg runs READ-ONLY against
+        a QUIESCED WAL and reports the zero-append claim as measured
+        columns: the WAL byte delta and log-length delta across the leg
+        (both exactly 0 — tests/test_read_plane.py asserts the same
+        invariant in-process). A trailing mixed phase drives writes and
+        quorum reads together at the engine-scenario queue depth."""
+        import tempfile
+
+        from etcd_tpu.server.engine import EngineConfig, MultiEngine
+        from etcd_tpu.server.request import Request
+
+        P = int(os.environ.get("BENCH_PEERS", 5))
+        G_q = int(os.environ.get("BENCH_QREAD_GROUPS",
+                                 min(G, 8192 if on_tpu else 1024)))
+        DEPTH = 64
+        with tempfile.TemporaryDirectory() as tmp:
+            eng = MultiEngine(EngineConfig(
+                groups=G_q, peers=P, data_dir=tmp, window=16, max_ents=4,
+                heartbeat_tick=3, fsync=True, stagger=True,
+                checkpoint_rounds=1 << 30))
+
+            def all_led():
+                return bool((np.where(eng.h_mask, eng.h_state, 0) == 2)
+                            .any(axis=1).all())
+
+            for _ in range(12):
+                eng.run_round()
+                if all_led():
+                    break
+            assert all_led(), "engine elections did not converge"
+
+            # Seed the key every read hits, one acked PUT per group.
+            put = Request(method="PUT", path="/bench/k", val="x" * 64)
+            with eng._lock:
+                for g in range(G_q):
+                    rq = Request(**{**put.__dict__,
+                                    "id": eng.reqid.next()})
+                    eng._pending[g].append(
+                        (rq.id, b"\x00" + rq.encode(), rq))
+                    eng._dirty.add(g)
+            for _ in range(400):
+                eng.run_round()
+                with eng._lock:
+                    if not any(eng._pending[g] for g in range(G_q)):
+                        break
+            eng._drain_applies()
+
+            def wal_bytes():
+                n = 0
+                for root, _dirs, files in os.walk(tmp):
+                    for f in files:
+                        try:
+                            n += os.path.getsize(os.path.join(root, f))
+                        except OSError:
+                            pass
+                return n
+
+            def log_len():
+                return int(np.where(eng.h_mask, eng.h_last, 0)
+                           .max(axis=1).sum())
+
+            # QUIESCE: commit-index convergence keeps appending
+            # hardstate diffs for a few rounds after the last ack — the
+            # zero-append baseline must be taken on a WAL that has
+            # stopped moving.
+            stable, wb = 0, wal_bytes()
+            for _ in range(400):
+                eng.run_round()
+                nb = wal_bytes()
+                stable = stable + 1 if nb == wb else 0
+                wb = nb
+                if stable >= 20:
+                    break
+
+            class _Sample:
+                __slots__ = ("t0", "t1")
+
+                def __init__(self):
+                    self.t0 = time.time()
+                    self.t1 = None
+
+                def put(self, value):
+                    self.t1 = time.time()
+
+            rsamples = []
+            gq = Request(method="GET", path="/bench/k", quorum=True)
+            rpool = []
+            for _ in range(1024):
+                rq = Request(**{**gq.__dict__, "id": eng.reqid.next()})
+                rpool.append((rq.id, rq))
+            qpool = []
+            for _ in range(1024):
+                rq = Request(**{**gq.__dict__, "method": "QGET",
+                                "id": eng.reqid.next()})
+                qpool.append((rq.id, b"\x00" + rq.encode(), rq))
+            wpool = []
+            for _ in range(1024):
+                rq = Request(**{**put.__dict__, "id": eng.reqid.next()})
+                wpool.append((rq.id, b"\x00" + rq.encode(), rq))
+            rp_i = qp_i = wp_i = 0
+
+            def offer_reads(depth, sample=True):
+                """Top the parked-read queues to `depth` per group; the
+                pooled items ride unregistered ids (wait.trigger no-ops),
+                one fresh-id waiter per round samples latency."""
+                nonlocal rp_i
+                item = None
+                if sample:
+                    rq = Request(**{**gq.__dict__,
+                                    "id": eng.reqid.next()})
+                    s = _Sample()
+                    eng.wait._waiters[rq.id] = s
+                    rsamples.append(s)
+                    item = (rq.id, rq)
+                added = 0
+                with eng._lock:
+                    for g in range(G_q):
+                        dq = eng._reads[g]
+                        while len(dq) < depth:
+                            dq.append(rpool[rp_i & 1023])
+                            rp_i += 1
+                            added += 1
+                        eng._read_dirty.add(g)
+                    if item is not None:
+                        eng._reads[0].append(item)
+                        eng._read_dirty.add(0)
+                        added += 1
+                    eng._reads_waiting += added
+                return added
+
+            def offer_writes(pool, depth):
+                nonlocal qp_i, wp_i
+                with eng._lock:
+                    for g in range(G_q):
+                        dq = eng._pending[g]
+                        while len(dq) < depth:
+                            if pool is qpool:
+                                dq.append(pool[qp_i & 1023])
+                                qp_i += 1
+                            else:
+                                dq.append(pool[wp_i & 1023])
+                                wp_i += 1
+                        eng._dirty.add(g)
+
+            def drain_reads():
+                for _ in range(400):
+                    eng.run_round()
+                    with eng._lock:
+                        if (eng._reads_waiting == 0
+                                and eng._ripe_waiting == 0):
+                            return
+
+            def drain_writes():
+                for _ in range(400):
+                    eng.run_round()
+                    with eng._lock:
+                        if not any(eng._pending[g] for g in range(G_q)):
+                            break
+                eng._drain_applies()
+
+            def leg_qread(end_t):
+                injected = 0
+                t0 = time.time()
+                r = 0
+                while time.time() < end_t or r < 10:
+                    injected += offer_reads(DEPTH)
+                    eng.run_round()
+                    r += 1
+                    if r >= 100000:
+                        break
+                with eng._lock:
+                    backlog = eng._reads_waiting + eng._ripe_waiting
+                elapsed = time.time() - t0
+                drain_reads()
+                return (injected - backlog) / elapsed
+
+            def leg_qget(end_t):
+                a0 = eng.acked_requests
+                t0 = time.time()
+                r = 0
+                while time.time() < end_t or r < 10:
+                    offer_writes(qpool, DEPTH)
+                    eng.run_round()
+                    r += 1
+                    if r >= 100000:
+                        break
+                elapsed = time.time() - t0
+                acked = eng.acked_requests - a0
+                drain_writes()
+                return acked / elapsed
+
+            # Warm the read plane BEFORE anything is timed or
+            # snapshotted: the first read round pays the read-step
+            # variant's XLA compile (~seconds), which would land on the
+            # first latency sample and the first leg's clock.
+            offer_reads(4, sample=False)
+            drain_reads()
+
+            # Leg schedule: zero-append qread first (the WAL is
+            # quiesced NOW), then the interleaved ratio legs, then the
+            # mixed phase.
+            span = max(sc_deadline - time.time(), 15.0)
+            t_base = time.time()
+            wb0, ll0 = wal_bytes(), log_len()
+            qread_legs = [leg_qread(t_base + 0.20 * span)]
+            wb1, ll1 = wal_bytes(), log_len()
+            qget_legs = [leg_qget(t_base + 0.36 * span)]
+            qread_legs.append(leg_qread(t_base + 0.52 * span))
+            qget_legs.append(leg_qget(t_base + 0.68 * span))
+            qread_legs.append(leg_qread(t_base + 0.84 * span))
+
+            # Mixed read/write phase at the same total depth.
+            a0 = eng.acked_requests
+            injected = 0
+            t0 = time.time()
+            r = 0
+            m_end = max(sc_deadline - 1.0, time.time() + 3.0)
+            while time.time() < m_end or r < 10:
+                injected += offer_reads(DEPTH // 2, sample=False)
+                offer_writes(wpool, DEPTH // 2)
+                eng.run_round()
+                r += 1
+                if r >= 100000:
+                    break
+            with eng._lock:
+                backlog = eng._reads_waiting + eng._ripe_waiting
+            m_elapsed = time.time() - t0
+            m_reads = (injected - backlog) / m_elapsed
+            m_writes = (eng.acked_requests - a0) / m_elapsed
+            drain_reads()
+            drain_writes()
+            eng.stop()
+
+        lats = [s.t1 - s.t0 for s in rsamples if s.t1 is not None]
+        p50 = (round(1000 * float(np.percentile(lats, 50)), 3)
+               if lats else None)
+        p99 = (round(1000 * float(np.percentile(lats, 99)), 3)
+               if lats else None)
+        rps = sum(qread_legs) / len(qread_legs)
+        qps = sum(qget_legs) / len(qget_legs)
+        ratio = round(rps / qps, 2) if qps > 0 else None
+        log(f"[qread] G={G_q} P={P} depth {DEPTH}: quorum reads "
+            f"{rps:,.0f}/s vs propose-path QGET {qps:,.0f}/s -> "
+            f"{ratio}x ({len(qread_legs)}+{len(qget_legs)} interleaved "
+            f"legs); read latency p50 {p50} p99 {p99} ms over "
+            f"{len(lats)} samples; read-only leg wal delta {wb1 - wb0} "
+            f"bytes / {ll1 - ll0} entries; mixed {m_reads:,.0f} reads/s "
+            f"+ {m_writes:,.0f} writes/s")
+        if wb1 != wb0 or ll1 != ll0:
+            log(f"ZERO-APPEND VIOLATION: read-only quorum-read leg "
+                f"moved the WAL ({wb1 - wb0} bytes, {ll1 - ll0} log "
+                f"entries) — the read plane is appending")
+        return {"commits_per_sec": round(rps, 1),
+                "qread_reads_per_sec": round(rps, 1),
+                "qget_reads_per_sec": round(qps, 1),
+                "qread_vs_qget": ratio,
+                "qread_p50_ms": p50,
+                "qread_p99_ms": p99,
+                "p50_commit_latency_ms": p50,
+                "p99_commit_latency_ms": p99,
+                "qread_wal_bytes_delta": int(wb1 - wb0),
+                "qread_log_delta": int(ll1 - ll0),
+                "mixed_reads_per_sec": round(m_reads, 1),
+                "mixed_acked_writes_per_sec": round(m_writes, 1),
+                "depth": DEPTH,
+                "groups": G_q,
+                "fsync": True}
+
+    def measure_watch_storm(sc_deadline):
+        """Watch fan-out under write load, at the store plane the
+        engine's appliers drive: W stream watchers spread over K keys
+        (the event-history ring records every mutation either way), one
+        writer mutating the keys round-robin with the write timestamp
+        as the value, consumer threads draining the watcher queues.
+        Reported: deliveries/s summed over all watchers and delivery
+        staleness (write timestamp -> consumer dequeue) p50/p99."""
+        import queue as _q
+        import threading as _th
+
+        from etcd_tpu.store import HAVE_NATIVE_STORE, new_store
+
+        W = int(os.environ.get("BENCH_WATCHERS",
+                               100_000 if on_tpu else 25_000))
+        K = int(os.environ.get("BENCH_WATCH_KEYS", 256))
+        st_ = new_store(history_capacity=8192)
+        watchers = [st_.watch(f"/storm/k{i % K}", recursive=False,
+                              stream=True, since_index=0)
+                    for i in range(W)]
+        end_t = max(time.time() + 5.0, sc_deadline - 2.0)
+        stop = _th.Event()
+        writes = [0]
+
+        def writer():
+            i = 0
+            while not stop.is_set() and time.time() < end_t:
+                st_.set_applied(f"/storm/k{i % K}", repr(time.time()),
+                                None, False)
+                i += 1
+            writes[0] = i
+
+        n_cons = 2
+        delivered = [0] * n_cons
+        stale = [[] for _ in range(n_cons)]
+
+        def consumer(ci):
+            part = watchers[ci::n_cons]
+            got = 0
+            samp = stale[ci]
+            while True:
+                moved = 0
+                for w in part:
+                    # Bounded drain per watcher per pass: a hot watcher
+                    # must not starve the rest of the partition.
+                    for _k in range(32):
+                        try:
+                            e = w._q.get_nowait()
+                        except _q.Empty:
+                            break
+                        got += 1
+                        moved += 1
+                        if got % 64 == 0 and e is not None and e.node:
+                            try:
+                                samp.append(time.time()
+                                            - float(e.node.value))
+                            except (TypeError, ValueError):
+                                pass
+                # Publish progress every pass and stop AT the window
+                # edge: the backlog still queued is exactly what the
+                # storm could not deliver in time — draining it after
+                # the clock stops would overstate throughput.
+                delivered[ci] = got
+                if stop.is_set() and moved == 0:
+                    break
+                if time.time() > end_t + 5.0:
+                    break
+
+        threads = [_th.Thread(target=writer, daemon=True)]
+        threads += [_th.Thread(target=consumer, args=(ci,), daemon=True)
+                    for ci in range(n_cons)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        while time.time() < end_t:
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15.0)
+        elapsed = time.time() - t0
+        dps = sum(delivered) / elapsed
+        wps = writes[0] / elapsed
+        samp = [s for lst in stale for s in lst]
+        p50 = (round(1000 * float(np.percentile(samp, 50)), 3)
+               if samp else None)
+        p99 = (round(1000 * float(np.percentile(samp, 99)), 3)
+               if samp else None)
+        log(f"[watch_storm] {W} stream watchers over {K} keys "
+            f"(native={HAVE_NATIVE_STORE}): {sum(delivered)} deliveries "
+            f"in {elapsed:.2f}s -> {dps:,.0f}/s ({wps:,.0f} writes/s, "
+            f"fan-out ~{W // K}/write); staleness p50 {p50} p99 {p99} "
+            f"ms over {len(samp)} samples")
+        return {"commits_per_sec": round(dps, 1),
+                "deliveries_per_sec": round(dps, 1),
+                "writes_per_sec": round(wps, 1),
+                "staleness_p50_ms": p50,
+                "staleness_p99_ms": p99,
+                "p50_commit_latency_ms": p50,
+                "p99_commit_latency_ms": p99,
+                "watchers": W,
+                "keys": K,
+                "native_store": HAVE_NATIVE_STORE}
+
+    def measure_expiry_wave(sc_deadline):
+        """Mass-TTL expiry through the engine: every tenant holds
+        BENCH_TTL_KEYS keys expiring at the SAME instant; the host's
+        sync scan (EngineConfig.sync_interval) stages one SYNC per due
+        tenant, each SYNC commits through consensus and its apply
+        sweeps the tenant's TTL heap (store delete_expired_keys).
+        Reported: expired keys/s over the wave and the round-loop
+        stall the wave adds (wave-round p99 vs quiesced-baseline p50)
+        — the wave must ride the normal round cadence, not freeze
+        it."""
+        import tempfile
+
+        from etcd_tpu.server.engine import EngineConfig, MultiEngine
+        from etcd_tpu.server.request import Request
+
+        P = int(os.environ.get("BENCH_PEERS", 5))
+        G_x = int(os.environ.get("BENCH_EXPIRY_GROUPS",
+                                 min(G, 4096 if on_tpu else 512)))
+        NK = int(os.environ.get("BENCH_TTL_KEYS", 16))
+        with tempfile.TemporaryDirectory() as tmp:
+            eng = MultiEngine(EngineConfig(
+                groups=G_x, peers=P, data_dir=tmp, window=16, max_ents=4,
+                heartbeat_tick=3, fsync=True, stagger=True,
+                sync_interval=0.05, checkpoint_rounds=1 << 30))
+
+            def all_led():
+                return bool((np.where(eng.h_mask, eng.h_state, 0) == 2)
+                            .any(axis=1).all())
+
+            for _ in range(12):
+                eng.run_round()
+                if all_led():
+                    break
+            assert all_led(), "engine elections did not converge"
+
+            # Load NK TTL keys per tenant, all due at exp_at.
+            exp_at = time.time() + max(
+                3.0, min(8.0, 0.3 * (sc_deadline - time.time())))
+            with eng._lock:
+                for g in range(G_x):
+                    for i in range(NK):
+                        rq = Request(method="PUT", path=f"/ttl/k{i}",
+                                     val="v", expiration=exp_at,
+                                     id=eng.reqid.next())
+                        eng._pending[g].append(
+                            (rq.id, b"\x00" + rq.encode(), rq))
+                    eng._dirty.add(g)
+            for _ in range(2000):
+                eng.run_round()
+                with eng._lock:
+                    if not any(eng._pending[g] for g in range(G_x)):
+                        break
+            eng._drain_applies()
+            loaded = G_x * NK
+
+            # Baseline cadence on the idle engine until the wave is due.
+            base_ms = []
+            while time.time() < exp_at - 0.2 and len(base_ms) < 4000:
+                t_r = time.perf_counter()
+                eng.run_round()
+                base_ms.append(1000 * (time.perf_counter() - t_r))
+            while time.time() < exp_at:
+                time.sleep(0.005)
+
+            # The wave: rounds until every tenant's TTL heap is empty.
+            wave_ms = []
+            t_w = time.time()
+            r = 0
+            left = G_x
+            while time.time() < sc_deadline and r < 20000:
+                t_r = time.perf_counter()
+                eng.run_round()
+                wave_ms.append(1000 * (time.perf_counter() - t_r))
+                r += 1
+                if r % 10 == 0:
+                    left = sum(1 for g in range(G_x)
+                               if eng.store(g).next_expiration()
+                               is not None)
+                    if left == 0:
+                        break
+            wave_elapsed = time.time() - t_w
+            eng._drain_applies()
+            if left:
+                left = sum(1 for g in range(G_x)
+                           if eng.store(g).next_expiration() is not None)
+            eng.stop()
+        # delete_expired_keys sweeps a tenant's due keys atomically, so
+        # the expired count is exact even on a deadline-truncated wave.
+        expired = loaded - left * NK
+        eps = expired / wave_elapsed if wave_elapsed > 0 else 0.0
+        base_p50 = (round(float(np.percentile(base_ms, 50)), 3)
+                    if base_ms else None)
+        wave_p99 = (round(float(np.percentile(wave_ms, 99)), 3)
+                    if wave_ms else None)
+        log(f"[expiry_wave] G={G_x} x {NK} TTL keys: {expired} expired "
+            f"in {wave_elapsed:.2f}s / {r} rounds -> {eps:,.0f} keys/s; "
+            f"round p99 during wave {wave_p99} ms vs idle baseline p50 "
+            f"{base_p50} ms ({left} tenants unswept)")
+        return {"commits_per_sec": round(eps, 1),
+                "expired_keys_per_sec": round(eps, 1),
+                "ttl_keys": loaded,
+                "unswept_tenants": int(left),
+                "round_stall_ms": wave_p99,
+                "baseline_round_p50_ms": base_p50,
+                "p50_commit_latency_ms": base_p50,
+                "p99_commit_latency_ms": wave_p99,
+                "groups": G_x,
+                "fsync": True}
+
     sel = scenario
     # churn LAST: it boots a second kernel geometry (7 peers, BASELINE
     # config 5) whose compile can eat a cold-cache TPU budget — the
@@ -896,13 +1392,18 @@ def child_main() -> int:
     # north-star G, latency at the per-chip shard shape) carry the
     # round's headline claims and get real time; zipf/lag are
     # comparatively quick synced loops.
-    _WEIGHTS = {"uniform": 0.28, "zipf": 0.08, "lag": 0.08,
-                "engine": 0.24, "latency": 0.22, "churn": 0.10}
+    _WEIGHTS = {"uniform": 0.22, "zipf": 0.06, "lag": 0.06,
+                "engine": 0.19, "latency": 0.16, "churn": 0.08,
+                "qread": 0.10, "watch_storm": 0.06, "expiry_wave": 0.07}
     # Serving scenarios directly after the primary: a time-boxed TPU run
     # (tunnel flakes eat budget) must land the north-star engine/latency
     # numbers before the quick synced loops, and churn stays last (its
-    # 7-peer geometry is a second cold compile).
-    order = (["uniform", "engine", "latency", "zipf", "lag", "churn"]
+    # 7-peer geometry is a second cold compile). The round-9 read/watch/
+    # expiry scenarios ride between them: qread reuses the engine
+    # scenario's compiled geometry family, watch_storm/expiry_wave are
+    # host-dominated.
+    order = (["uniform", "engine", "latency", "qread", "watch_storm",
+              "expiry_wave", "zipf", "lag", "churn"]
              if sel == "all" else [sel])
     results = {}
     if (sel == "all" and not on_tpu
@@ -981,6 +1482,12 @@ def child_main() -> int:
             results[sc] = measure_engine(sc_deadline, G_e=G_lat,
                                          sat_frac=0.35, label=sc)
             results[sc]["target_p99_ms"] = 10.0
+        elif sc == "qread":
+            results[sc] = measure_qread(sc_deadline)
+        elif sc == "watch_storm":
+            results[sc] = measure_watch_storm(sc_deadline)
+        elif sc == "expiry_wave":
+            results[sc] = measure_expiry_wave(sc_deadline)
         elif sc == "zipf":
             res, st, inbox = measure_zipf(st, inbox, sc_deadline, rounds)
             results[sc] = res
@@ -1144,7 +1651,9 @@ def _regression_gate(line: str, artifact_dir=None) -> None:
         if not o:
             continue
         geom_keys = {"churn": "peers", "engine": "groups",
-                     "latency": "groups"}.get(sc)
+                     "latency": "groups", "qread": "groups",
+                     "expiry_wave": "groups",
+                     "watch_storm": "watchers"}.get(sc)
         # Geometry tuple: the scenario's own shape key where it has one,
         # the platform (older artifacts carry no per-scenario platform
         # key — fall back to the artifact-level platform on BOTH sides,
@@ -1173,6 +1682,20 @@ def _regression_gate(line: str, artifact_dir=None) -> None:
         for col in ("wal_fsync_p50_ms", "wal_fsync_p99_ms"):
             cmp(f"{sc}.{col}", v.get(col), o.get(col), wg_n, wg_o,
                 lower_better=True)
+        # Round-9 read/watch/expiry columns, gated only when BOTH
+        # artifacts carry them (older rounds predate the read plane).
+        # Throughputs already ride the generic commits_per_sec mirror
+        # above; here the LOWER-is-better tails (read latency, watch
+        # staleness, expiry round-stall) gate a >20% RISE, and the
+        # read-plane advantage ratio gates a >20% fall — a qread that
+        # drifts back toward the propose path's cost is a regression
+        # even if absolute reads/s held up.
+        for col, lb in (("qread_vs_qget", False),
+                        ("qread_p99_ms", True),
+                        ("staleness_p99_ms", True),
+                        ("round_stall_ms", True)):
+            cmp(f"{sc}.{col}", v.get(col), o.get(col), ng, og,
+                lower_better=lb)
         # Instrumentation-overhead budget: the observability plane may
         # cost at most 3% of deep-queue throughput in its own
         # interleaved A/B (absolute budget, not vs the prior artifact —

@@ -866,15 +866,25 @@ def _quiet_msgs(st: GroupState, cfg: KernelConfig, inbox: jax.Array,
 
 def _step_body(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
                prop_count: jax.Array, prop_slot: Optional[jax.Array],
-               tick: jax.Array, quiet: bool) -> Tuple[GroupState, jax.Array]:
+               tick: jax.Array, quiet: bool,
+               force_hb: bool = False) -> Tuple[GroupState, jax.Array]:
     """Shared round skeleton; `quiet` (Python bool, traced twice under the
     cond) selects the message-phase implementation. prop_slot=None selects
     per-SLOT proposal admission (prop_count is then (G, P) — the
-    multi-host engine's sharded input)."""
+    multi-host engine's sharded input). `force_hb` (Python bool) makes
+    every active leader broadcast a heartbeat this pass regardless of its
+    heartbeat clock — the ReadIndex step uses it to solicit the quorum
+    acks that confirm leadership (reference bcastHeartbeat on a pending
+    read, raft.go:313-321 via step MsgReadIndex)."""
     active = active_mask(st)
     P = st.term.shape[1]
     st = st._replace(ack_age=jnp.minimum(st.ack_age + 1, 1 << 20))
     st, hb_fire, vote_fire = _tick(st, cfg, active, tick)
+    if force_hb:
+        ldr = active & (st.state == LEADER)
+        hb_fire = _where(ldr, st.term, hb_fire)
+        # The broadcast resumes paused probes, exactly like a timed one.
+        st = st._replace(paused=_where(ldr[..., None], False, st.paused))
     lead_term0 = _where(st.state == LEADER, st.term, 0)
     if quiet:
         st, resp = _quiet_msgs(st, cfg, inbox, active)
@@ -944,6 +954,121 @@ def route_local(outbox: jax.Array) -> jax.Array:
     (reference rafthttp/, 4187 lines) collapses to this when peers are
     co-located as array rows."""
     return jnp.swapaxes(outbox, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# Batched ReadIndex (the zero-append linearizable read plane)
+# ---------------------------------------------------------------------------
+
+def _at_slot(x: jax.Array, slot: jax.Array) -> jax.Array:
+    """x[g, slot[g]] for x (G, P), slot (G,) — one-hot select-sum instead
+    of a computed-index gather (same TPU reasoning as ring_lookup)."""
+    P = x.shape[1]
+    oh = jnp.arange(P, dtype=jnp.int32)[None, :] == slot[:, None]
+    return jnp.sum(jnp.where(oh, x, 0), axis=1, dtype=x.dtype)
+
+
+def _read_register(st: GroupState, cfg: KernelConfig
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Register a batched ReadIndex for every group at once: capture
+    (read_slot, read_term, read_commit, has_ldr), all (G,).
+
+    read_commit is the leader's commit index AT REGISTRATION — the index
+    the reference's ReadIndex protocol hands back (raft.go step
+    MsgReadIndex: r.readOnly.addRequest captures r.raftLog.committed).
+    has_ldr additionally requires the leader to have committed an entry
+    of its OWN term (the no-op): until then its commit index may lag
+    entries a prior leader already committed (Raft §8; the reference
+    rejects ReadIndex before the no-op commits, raft.go:872-880). The
+    term of the entry at `commit` is resolved from the leader's ring —
+    unresolvable (outside the device window) reads as not-confirmed,
+    which is conservative: the engine just retries next round."""
+    lead_term = jnp.where(active_mask(st) & (st.state == LEADER),
+                          st.term, 0)
+    read_slot = jnp.argmax(lead_term, axis=1).astype(jnp.int32)
+    read_term = jnp.max(lead_term, axis=1)
+    read_commit = _at_slot(st.commit, read_slot)
+    commit_term = _at_slot(term_at(st, cfg, st.commit), read_slot)
+    has_ldr = (read_term > 0) & (commit_term == read_term)
+    return read_slot, read_term, read_commit, has_ldr
+
+
+@functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=_donate_at_import((1, 2)))
+def step_routed_read_auto(cfg: KernelConfig, st: GroupState,
+                          inbox: jax.Array, prop_count: jax.Array,
+                          prop_slot: jax.Array, tick: jax.Array,
+                          drop_mask=None, hops: int = 1
+                          ) -> Tuple[GroupState, jax.Array, jax.Array,
+                                     jax.Array]:
+    """step_routed_auto plus a batched ReadIndex pass: returns
+    (st, inbox, confirmed (G,) bool, read_commit (G,) int32).
+
+    Protocol (reference raft.go step MsgReadIndex + ReadOnlySafe recvAck,
+    data-parallel over (groups, peers)): each group's leader registers
+    the read at invocation start — capturing its commit index — then
+    hop 0 forces a heartbeat broadcast (`force_hb`) and every subsequent
+    hop counts the M_HB_RESP / M_APP_RESP messages routed back to the
+    leader slot AT the registered term. A group is `confirmed` when the
+    leader (still leader, same term, own-term entry committed) holds
+    acks from a quorum including itself. Nothing is appended: the whole
+    pass piggybacks on the existing heartbeat/append-response machinery,
+    so a confirmed read costs zero log entries and zero WAL bytes.
+
+    Freshness: only messages produced INSIDE this invocation are counted
+    (the ack scan runs after each hop's routing, never on the caller's
+    initial inbox). A response carrying term T generated here proves the
+    sender's term was still T after registration — so no term>T leader
+    can have committed anything the registered read_commit misses. Stale
+    mailbox contents predate registration and prove nothing; they are
+    consumed by hop 0 but never counted.
+
+    With hops >= 2 a quiescent group confirms within ONE invocation
+    (hop 0 emits the forced heartbeat, hop 1 delivers + responds, the
+    ack scan after hop 1 sees it). At hops == 1 confirmation still
+    arrives opportunistically (responses to the previous round's
+    traffic) or on the NEXT invocation — callers just retry unconfirmed
+    groups. Proposals/tick fire on hop 0 exactly like step_routed_auto:
+    a read round is also a full write round."""
+    G, P = st.term.shape
+    read_slot, read_term, read_commit, has_ldr = _read_register(st, cfg)
+    oh_lead = (jnp.arange(P, dtype=jnp.int32)[None, :]
+               == read_slot[:, None])                        # (G, P)
+    acks = jnp.zeros((G, P), bool)
+    for h in range(hops):
+        pc = prop_count if h == 0 else jnp.zeros_like(prop_count)
+        tk = tick if h == 0 else jnp.asarray(False)
+        active = active_mask(st)
+        quiet = _quiet_pred(st, cfg, inbox, active, tk)
+
+        def fast(ops, _h=h):
+            st, inbox, pc, ps, tick = ops
+            s, out = _step_body(cfg, st, inbox, pc, ps, tick, quiet=True,
+                                force_hb=(_h == 0))
+            return s, route_local(out)
+
+        def full(ops, _h=h):
+            st, inbox, pc, ps, tick = ops
+            s, out = _step_body(cfg, st, inbox, pc, ps, tick, quiet=False,
+                                force_hb=(_h == 0))
+            return s, route_local(out)
+
+        st, inbox = jax.lax.cond(quiet, fast, full,
+                                 (st, inbox, pc, prop_slot, tk))
+        if drop_mask is not None:
+            inbox = inbox * drop_mask
+        # Messages routed to the registered leader slot this hop.
+        to_lead = jnp.sum(
+            inbox * oh_lead[:, :, None, None].astype(jnp.int32),
+            axis=1, dtype=jnp.int32)                         # (G, P_from, F)
+        mt = to_lead[..., F_TYPE]
+        fresh = (((mt == M_HB_RESP) | (mt == M_APP_RESP))
+                 & (to_lead[..., F_TERM] == read_term[:, None]))
+        acks = acks | fresh
+    n_acks = jnp.sum((acks & ~oh_lead).astype(jnp.int32), axis=1)
+    still = ((_at_slot(st.state, read_slot) == LEADER)
+             & (_at_slot(st.term, read_slot) == read_term))
+    confirmed = has_ldr & still & (n_acks + 1 >= quorum(st))
+    return st, inbox, confirmed, read_commit
 
 
 # Per-(g, p) change flags emitted by step_routed_compact.
@@ -1084,6 +1209,7 @@ def step_routed(cfg: KernelConfig, st: GroupState, inbox: jax.Array,
 _STEP_STATICS = {
     "step_routed_auto": (0, 7),
     "step_routed_compact": (0, 7),
+    "step_routed_read_auto": (0, 7),
     "step_routed_slots_auto": (0, 6),
 }
 

@@ -259,6 +259,15 @@ class EngineConfig:
     # defense-in-depth (repairs only fire with ETCD_TPU_DONATE=on);
     # donating backends keep the safety net. 0 disables.
     mask_check_rounds: int = 64
+    # Leader-lease read fast path (OFF by default). After a ReadIndex
+    # round confirms a group's leader, quorum reads arriving within the
+    # next read_lease_ms milliseconds skip the confirmation round and
+    # park directly at the current commit mirror. This trades the strict
+    # message-proven ReadIndex guarantee for the classic clock-bound
+    # lease assumption (bounded drift: a deposed leader's host notices
+    # within the lease window); 0 keeps every quorum read on the full
+    # confirmation path.
+    read_lease_ms: int = 0
 
 
 class _AckCounter:
@@ -397,6 +406,31 @@ class MultiEngine:
             lambda st, inbox, pc, ps, t: _compact_step(
                 self.kcfg, st, inbox, pc, ps, t, self.drop_mask,
                 self.cfg.hops))
+        # The ReadIndex step (the zero-append read plane): the same
+        # routed round plus a forced leader heartbeat and a per-group
+        # read-quorum tally — one extra (G,) confirmed flag and one (G,)
+        # captured commit index come back with the state. The mesh path
+        # pins both to a groups-sharded layout next to the state/mailbox
+        # shardings; the non-mesh path rides step_variant (CPU donation
+        # hazard twin, same as the other kernels).
+        if cfg.mesh is not None:
+            import functools
+            from jax.sharding import NamedSharding, PartitionSpec
+            _g_sh = NamedSharding(cfg.mesh, PartitionSpec("groups"))
+            _mesh_read = jax.jit(
+                functools.partial(kernel.step_routed_read_auto.__wrapped__,
+                                  self.kcfg, hops=cfg.hops),
+                donate_argnums=kernel.donate_safe((0, 1)),
+                out_shardings=(self._st_sh, self._mb_sh, _g_sh, _g_sh))
+            self._step_fn_r = (
+                lambda st, inbox, pc, ps, t: _mesh_read(
+                    st, inbox, pc, ps, t, self.drop_mask))
+        else:
+            _read_step = kernel.step_variant("step_routed_read_auto")
+            self._step_fn_r = (
+                lambda st, inbox, pc, ps, t: _read_step(
+                    self.kcfg, st, inbox, pc, ps, t, self.drop_mask,
+                    self.cfg.hops))
 
         # Geometry guard BEFORE anything touches the data dir: a mismatch
         # must refuse the dir before the WAL opens/creates any file in it.
@@ -410,6 +444,23 @@ class MultiEngine:
         # (request id, tagged payload) items coalesced into one log entry.
         # g -> (leader_slot, [entry batches]) staged this round
         self._staged: Dict[int, Tuple[int, list]] = {}
+        # The read plane's two parking lots (both under self._lock):
+        # _reads holds quorum reads waiting for a ReadIndex confirmation
+        # (rid, Request); _ripe holds confirmed reads waiting for the
+        # apply cursor to reach their read index (rid, Request, index).
+        # The waiting counters let run_round skip the plane when idle,
+        # and the dirty sets bound per-round scans to active groups.
+        self._reads: List[deque] = [deque() for _ in range(G)]
+        self._read_dirty: set = set()
+        self._ripe: List[deque] = [deque() for _ in range(G)]
+        self._ripe_dirty: set = set()
+        self._reads_waiting = 0
+        self._ripe_waiting = 0
+        # Leader-lease fast path state (cfg.read_lease_ms): per-group
+        # monotonic-clock deadline and the term the lease was granted
+        # under — a lease dies with its term.
+        self._lease_until = np.zeros(G, np.float64)
+        self._lease_term = np.zeros(G, np.int64)
         self._stores: Dict[int, Any] = {}
         self._lock = threading.Lock()       # guards _pending/_dirty enqueue
         self._stop_ev = threading.Event()
@@ -805,6 +856,10 @@ class MultiEngine:
         for sh in self._appliers:
             if sh.thread is not None:
                 sh.thread.join(timeout=10)
+        # Parked quorum reads can never ripen once the round loop is
+        # down; fail them now instead of letting clients ride out the
+        # request timeout.
+        self._fail_parked_reads("engine stopped")
         self.wal.close()
 
     # ------------------------------------------------------------------
@@ -996,6 +1051,12 @@ class MultiEngine:
         kernel's consensus."""
         if r.method == METHOD_GET:
             if r.quorum:
+                if not r.wait:
+                    # The zero-append read plane: ReadIndex confirmation
+                    # + local serve; no log entry, no WAL bytes, no
+                    # fsync. (A quorum WATCH still rides the propose
+                    # path below, unchanged.)
+                    return self._quorum_read(g, r, timeout)
                 r = Request(**{**r.__dict__, "method": METHOD_QGET})
             elif r.wait:
                 return self.store(g).watch(r.path, r.recursive, r.stream,
@@ -1050,6 +1111,180 @@ class MultiEngine:
             # off the (serialized) apply stage.
             return result.resolve()
         return result
+
+    # ------------------------------------------------------------------
+    # the read plane (batched ReadIndex; zero-append quorum reads)
+    # ------------------------------------------------------------------
+
+    def _mirror_term(self, g: int) -> int:
+        return int(np.where(self.h_mask[g], self.h_term[g], 0).max())
+
+    def _mirror_commit(self, g: int) -> int:
+        return int(np.where(self.h_mask[g], self.h_commit[g], 0).max())
+
+    def _quorum_read(self, g: int, r: Request,
+                     timeout: Optional[float] = None) -> Any:
+        """Linearizable GET without a log entry (the reference's
+        ReadIndex protocol, raft read_only.go, batched over all G
+        groups): park the read, let the next round's ReadIndex step
+        confirm the group's leader still holds a quorum and capture its
+        commit index, then serve from the local store once the apply
+        cursor reaches that index. Quorum reads leave the
+        etcd_server_proposal_* families entirely (nothing is proposed)
+        and meter the read_index_* families instead."""
+        if r.id == 0:
+            r = Request(**{**r.__dict__, "id": self.reqid.next()})
+        obs_on = self.obs.enabled
+        tr = self.obs.tracer
+        if tr.every:
+            tr.mark(r.id, "submit", g=g)
+        q = self.wait.register(r.id)
+        t0 = time.perf_counter()
+        with self._lock:
+            lease_ms = self.cfg.read_lease_ms
+            if (lease_ms > 0
+                    and time.monotonic() < float(self._lease_until[g])
+                    and int(self._lease_term[g]) == self._mirror_term(g)):
+                # Lease fast path: a confirmation round within the lease
+                # window proved leadership, and the lease term still
+                # matches — skip the confirmation and park directly at
+                # the CURRENT commit mirror (>= every acked write's
+                # index, so acked writes stay visible).
+                self._ripe[g].append((r.id, r, self._mirror_commit(g)))
+                self._ripe_dirty.add(g)
+                self._ripe_waiting += 1
+                if obs_on:
+                    self.obs.c_reads_lease.inc()
+            else:
+                self._reads[g].append((r.id, r))
+                self._read_dirty.add(g)
+                self._reads_waiting += 1
+            if obs_on:
+                self.obs.g_read_parked.inc()
+        try:
+            result = q.get(timeout=timeout or self.cfg.request_timeout)
+        except queue.Empty:
+            if obs_on:
+                self.obs.c_reads_failed.inc()
+            self.wait.cancel(r.id)
+            raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
+                                   cause="quorum read timed out",
+                                   index=int(self.applied[g]))
+        finally:
+            if obs_on:
+                self.obs.g_read_parked.dec()
+        if obs_on:
+            self.obs.s_read_dur.observe(
+                (time.perf_counter() - t0) * 1000.0)
+        if isinstance(result, errors.EtcdError):
+            raise result
+        return result
+
+    def _confirm_reads(self, read_take: Dict[int, int], conf: np.ndarray,
+                       rc: np.ndarray) -> None:
+        """Move snapshotted parked reads of confirmed groups to the ripe
+        queue at this round's captured read index. Only the
+        PRE-DISPATCH snapshot count moves — a read that parked after the
+        step was dispatched could postdate a write acked at a commit
+        index above the captured one, so it waits for its own round.
+        Unconfirmed groups keep their reads parked: a deposed leader's
+        reads either re-confirm under the next leader (at its >= read
+        index — still linearizable) or time out; never served stale."""
+        o = self.obs if self.obs.enabled else None
+        n_conf = 0
+        now = time.monotonic()
+        lease_s = self.cfg.read_lease_ms / 1000.0
+        with self._lock:
+            for g, take in read_take.items():
+                if not conf[g]:
+                    continue
+                n_conf += 1
+                ri = int(rc[g])
+                dq = self._reads[g]
+                moved = min(take, len(dq))
+                for _ in range(moved):
+                    self._ripe[g].append(dq.popleft() + (ri,))
+                if moved:
+                    self._ripe_dirty.add(g)
+                    self._ripe_waiting += moved
+                    self._reads_waiting -= moved
+                if not dq:
+                    self._read_dirty.discard(g)
+                if lease_s > 0:
+                    # A confirmed quorum round proves leadership NOW;
+                    # the clock bound extends it lease_ms forward.
+                    self._lease_until[g] = now + lease_s
+                    self._lease_term[g] = self._mirror_term(g)
+        if o:
+            o.h_read_confirms.observe(n_conf)
+
+    def _serve_ripe_reads(self) -> None:
+        """Serve every ripe read whose group's apply cursor has reached
+        its read index. Queue surgery holds self._lock; the store gets
+        (GIL-released in the C core) and waiter triggers run outside
+        it. Per group the ripe queue is FIFO and read indexes are
+        nondecreasing (commit is monotone within a term, and a new
+        leader's own-term-committed index covers everything previously
+        committed), so serving stops at the first not-yet-applied
+        head."""
+        served: List[Tuple[int, Request, int]] = []
+        with self._lock:
+            for g in list(self._ripe_dirty):
+                dq = self._ripe[g]
+                a = int(self.applied[g])
+                while dq and dq[0][2] <= a:
+                    rid, r, _ri = dq.popleft()
+                    served.append((rid, r, g))
+                if not dq:
+                    self._ripe_dirty.discard(g)
+            self._ripe_waiting -= len(served)
+        if not served:
+            return
+        o = self.obs if self.obs.enabled else None
+        tr = self.obs.tracer
+        # Read coalescing: every read in this pass is at-or-past its
+        # read index NOW, so one store get per distinct (group, path,
+        # recursive, sorted) answers all of them — the get's instant
+        # lies inside every coalesced read's [park, serve] window,
+        # which is all linearizability requires. (The reference serves
+        # a whole ReadIndex batch from one state the same way,
+        # read_only.go advance; hot-key read storms collapse to one
+        # tree walk per key per round.)
+        memo: Dict[Tuple[int, str, bool, bool], Any] = {}
+        for rid, r, g in served:
+            k = (g, r.path, r.recursive, r.sorted)
+            result = memo.get(k)
+            if result is None:
+                try:
+                    result = self.store(g).get(r.path, r.recursive,
+                                               r.sorted)
+                except errors.EtcdError as err:
+                    result = err
+                memo[k] = result
+            self.wait.trigger(rid, result)
+            if tr.every:
+                tr.mark(rid, "acked", g=g)
+        if o:
+            o.c_reads_served.inc(len(served))
+
+    def _fail_parked_reads(self, why: str) -> None:
+        """Fail every parked and ripe quorum read (engine shutdown) so
+        serving threads don't ride out the full request timeout."""
+        rids: List[int] = []
+        with self._lock:
+            for g in self._read_dirty:
+                rids.extend(rid for rid, _r in self._reads[g])
+                self._reads[g].clear()
+            for g in self._ripe_dirty:
+                rids.extend(rid for rid, _r, _i in self._ripe[g])
+                self._ripe[g].clear()
+            self._read_dirty.clear()
+            self._ripe_dirty.clear()
+            self._reads_waiting = 0
+            self._ripe_waiting = 0
+        for rid in rids:
+            self.wait.trigger(rid, errors.EtcdError(
+                errors.ECODE_RAFT_INTERNAL, cause=why))
 
     def conf_change(self, g: int, op: str, slot: int,
                     timeout: Optional[float] = None) -> List[int]:
@@ -1405,6 +1640,22 @@ class MultiEngine:
             prop_count[staged_gs] = cnt_l
             prop_slot[staged_gs] = ss_l
 
+        # -- 1b. read plane: snapshot how many parked quorum reads each
+        # group carries BEFORE the step is dispatched. A read parking
+        # after this point must not adopt this round's confirmation —
+        # an applier running under the device step could ack a write
+        # whose commit index exceeds the index this round captures, and
+        # serving such a late read at the captured index would miss that
+        # acked write. The snapshot pins exactly which reads this
+        # round's confirmation covers (see tests/test_read_plane.py).
+        read_take: Optional[Dict[int, int]] = None
+        if self._reads_waiting:
+            with self._lock:
+                if self._reads_waiting:
+                    read_take = {g: len(self._reads[g])
+                                 for g in self._read_dirty
+                                 if self._reads[g]}
+
         ph = self.phase_s
         t_ph = time.perf_counter()
         ph["stage"] = ph.get("stage", 0.0) + (t_ph - t_round)
@@ -1415,7 +1666,17 @@ class MultiEngine:
         # dispatch; jax queues it and returns immediately) ----------------
         tick = (self.round_no % self.cfg.ticks_per_round) == 0
         flags_d = anh_d = None
-        if self._compact:
+        conf_d = rc_d = None
+        if read_take:
+            # A ReadIndex round is a full round (proposals, ticks and
+            # the forced leader heartbeat all ride the same program) but
+            # skips the compact path: the read step returns no flag map,
+            # and the confirmation wants the full mirror refresh anyway.
+            st, inbox, conf_d, rc_d = self._step_fn_r(
+                self.st, self.inbox,
+                jnp.asarray(prop_count), jnp.asarray(prop_slot),
+                jnp.asarray(bool(tick)))
+        elif self._compact:
             st, inbox, flags_d, anh_d = self._step_fn_c(
                 self.st, self.inbox,
                 jnp.asarray(prop_count), jnp.asarray(prop_slot),
@@ -1442,7 +1703,7 @@ class MultiEngine:
         need_host = None
         d_readback = d_record = 0.0
         t_stepped = t_ph
-        if self._compact:
+        if flags_d is not None:
             # Check the 1-byte attestation BEFORE pulling the flag map:
             # need-host/post-surgery rounds take the full readback anyway
             # and must not pay a discarded (G, P) transfer first.
@@ -1549,6 +1810,15 @@ class MultiEngine:
             ph["record"] = ph.get("record", 0.0) + d_record
             t_ph = t_now
 
+        # -- 5b. read plane: pop the snapshotted reads of every group
+        # whose ReadIndex confirmation landed into the ripe queue at the
+        # captured commit index (read rounds always take the full
+        # readback above, so the mirrors the confirmation consults are
+        # this round's).
+        if conf_d is not None:
+            self._confirm_reads(read_take, np.asarray(conf_d),
+                                np.asarray(rc_d))
+
         # -- 6. persist, then apply+ack. WAL fsync strictly precedes the
         # acks of everything this round committed (doc.go:31-39 ordering)
         # — by GATING, not by inline ordering: the record is handed to
@@ -1602,6 +1872,14 @@ class MultiEngine:
                     o.c_acked.inc(self._acks.acked - a0)
         else:
             self._enqueue_apply(self._commit_view())
+
+        # -- 6b. read plane: serve every ripe read whose group has
+        # applied past its read index. Sync rounds serve their own reads
+        # immediately (the inline apply above advanced the cursor);
+        # pipelined rounds serve reads the applier shards ripened while
+        # the device step ran — at most one round of extra latency.
+        if self._ripe_waiting:
+            self._serve_ripe_reads()
 
         # -- 7. need_host: snapshot-install lagging followers (violations
         # already failed the round before anything was persisted or

@@ -64,6 +64,7 @@ from etcd_tpu.server.enginewal import EngineWAL, RoundRecord, b64_np, np_b64
 from etcd_tpu.server.request import (METHOD_DELETE, METHOD_GET, METHOD_POST,
                                      METHOD_PUT, METHOD_QGET, METHOD_SYNC,
                                      Request)
+from etcd_tpu.server import obs as obs_mod
 from etcd_tpu.store import new_store
 from etcd_tpu.store.event import LazyWriteEvent
 from etcd_tpu.utils import idutil, metrics
@@ -208,6 +209,15 @@ class HostEngine:
         self.reqid = idutil.Generator(cfg.host_id + 1)
         self._pending: List[deque] = [deque() for _ in range(G)]
         self._dirty: set = set()
+        # The read plane (collective plane only; see _quorum_read):
+        # parked quorum reads awaiting a leadership confirmation, and
+        # ripe ones awaiting the apply cursor. Both under self._lock.
+        self._reads: List[deque] = [deque() for _ in range(G)]
+        self._read_dirty: set = set()
+        self._reads_waiting = 0
+        self._ripe: List[deque] = [deque() for _ in range(G)]
+        self._ripe_dirty: set = set()
+        self._ripe_waiting = 0
         self._staged: Dict[int, List[List[Tuple[int, bytes]]]] = {}
         self._stores: Dict[int, Any] = {}
         self._lock = threading.Lock()
@@ -749,6 +759,7 @@ class HostEngine:
         self._stop_ev.set()
         if self._thread is not None:
             self._thread.join(timeout=15)
+        self._fail_parked_reads("engine stopped")
         self.frames.stop()
         self.wal.close()
 
@@ -829,6 +840,14 @@ class HostEngine:
         writes ride consensus and ack after LOCAL fsync+apply)."""
         if r.method == METHOD_GET:
             if r.quorum:
+                if (not r.wait and not self._frames_plane
+                        and self.l_state[g] == _LEADER):
+                    # Zero-append read plane, collective plane only: the
+                    # SPMD round is globally synchronous, so leadership
+                    # confirmation needs no extra messages (see
+                    # _confirm_reads). Frames-plane hosts and non-leader
+                    # columns keep the QGET forward path below.
+                    return self._quorum_read(g, r, timeout)
                 r = Request(**{**r.__dict__, "method": METHOD_QGET})
             elif r.wait:
                 return self.store(g).watch(r.path, r.recursive, r.stream,
@@ -868,6 +887,144 @@ class HostEngine:
             # here on the serving thread (see MultiEngine.do).
             return result.resolve()
         return result
+
+    # ------------------------------------------------------------------
+    # the read plane (collective plane; see MultiEngine._quorum_read)
+    # ------------------------------------------------------------------
+
+    def _quorum_read(self, g: int, r: Request,
+                     timeout: Optional[float] = None) -> Any:
+        """Linearizable GET without a log entry: park the read, confirm
+        leadership at the next round's readback, serve from the local
+        store once the apply cursor reaches the captured commit index.
+        Quorum reads leave etcd_server_proposal_* (nothing is proposed)
+        and meter the read_index_* families."""
+        if r.id == 0:
+            r = Request(**{**r.__dict__, "id": self.reqid.next()})
+        q = self.wait.register(r.id)
+        import queue as _q
+        t0 = time.perf_counter()
+        obs_mod.read_index_parked.inc()
+        with self._lock:
+            self._reads[g].append((r.id, r))
+            self._read_dirty.add(g)
+            self._reads_waiting += 1
+        try:
+            result = q.get(timeout=timeout or self.cfg.request_timeout)
+        except _q.Empty:
+            self.wait.cancel(r.id)
+            obs_mod.read_index_failed.inc()
+            raise errors.EtcdError(errors.ECODE_RAFT_INTERNAL,
+                                   cause="quorum read timed out",
+                                   index=int(self.applied[g]))
+        finally:
+            obs_mod.read_index_parked.dec()
+        obs_mod.read_index_durations.observe(
+            (time.perf_counter() - t0) * 1000.0)
+        if isinstance(result, errors.EtcdError):
+            raise result
+        return result
+
+    def _confirm_reads(self, read_take: Dict[int, int], state, term,
+                       commit, last, ring) -> None:
+        """Collective-plane ReadIndex confirmation, against the arrays
+        just read back. Soundness: the SPMD collective is globally
+        synchronous and lossless (the mailbox transpose is one
+        all_to_all inside the program), so a column still reading LEADER
+        after round k proves no higher-term leader has committed
+        anything through round k — its campaign traffic would have
+        reached every column (including ours, flipping us to follower)
+        at least one full round before its first possible own-term
+        commit. The leader must additionally hold its own-term entry
+        committed (the reference ReadIndex precondition, raft §8): a
+        fresh leader's commit mirror may still lag writes the previous
+        leader acked. Deposed columns FAIL their parked reads — the
+        client retries through the forward path; nothing is ever served
+        at a stale index."""
+        W = self.cfg.window
+        failed: List[Tuple[int, int]] = []
+        confirmed = 0
+        with self._lock:
+            for g, take in read_take.items():
+                dq = self._reads[g]
+                take = min(take, len(dq))
+                c = int(commit[g])
+                own_term = (state[g] == _LEADER and c >= 1
+                            and c > int(last[g]) - W
+                            and int(ring[g, c % W]) == int(term[g]))
+                if own_term:
+                    confirmed += 1
+                    for _ in range(take):
+                        self._ripe[g].append(dq.popleft() + (c,))
+                    if take:
+                        self._ripe_dirty.add(g)
+                        self._ripe_waiting += take
+                        self._reads_waiting -= take
+                elif state[g] != _LEADER:
+                    for _ in range(take):
+                        rid, _r = dq.popleft()
+                        failed.append((rid, g))
+                    self._reads_waiting -= take
+                # else: leader, own-term entry not committed yet — the
+                # parked reads retry at the next round's readback.
+                if not dq:
+                    self._read_dirty.discard(g)
+        obs_mod.read_index_confirms.observe(confirmed)
+        for rid, g in failed:
+            self.wait.trigger(rid, errors.EtcdError(
+                errors.ECODE_RAFT_INTERNAL,
+                cause="leadership lost during quorum read",
+                index=int(self.applied[g])))
+
+    def _serve_ripe_reads(self) -> None:
+        """Serve every ripe read whose group's apply cursor reached its
+        read index (the in-round apply just ran, so this is usually the
+        same round that confirmed)."""
+        served: List[Tuple[int, Request, int]] = []
+        with self._lock:
+            for g in list(self._ripe_dirty):
+                dq = self._ripe[g]
+                a = int(self.applied[g])
+                while dq and dq[0][2] <= a:
+                    rid, r, _ri = dq.popleft()
+                    served.append((rid, r, g))
+                if not dq:
+                    self._ripe_dirty.discard(g)
+            self._ripe_waiting -= len(served)
+        # Same read coalescing as MultiEngine._serve_ripe_reads: one
+        # get per distinct (group, path, recursive, sorted) serves the
+        # whole pass linearizably.
+        memo: Dict[Tuple[int, str, bool, bool], Any] = {}
+        for rid, r, g in served:
+            k = (g, r.path, r.recursive, r.sorted)
+            result = memo.get(k)
+            if result is None:
+                try:
+                    result = self.store(g).get(r.path, r.recursive,
+                                               r.sorted)
+                except errors.EtcdError as err:
+                    result = err
+                memo[k] = result
+            self.wait.trigger(rid, result)
+        if served:
+            obs_mod.read_index_served.inc(len(served))
+
+    def _fail_parked_reads(self, why: str) -> None:
+        rids: List[int] = []
+        with self._lock:
+            for g in self._read_dirty:
+                rids.extend(rid for rid, _r in self._reads[g])
+                self._reads[g].clear()
+            for g in self._ripe_dirty:
+                rids.extend(rid for rid, _r, _i in self._ripe[g])
+                self._ripe[g].clear()
+            self._read_dirty.clear()
+            self._ripe_dirty.clear()
+            self._reads_waiting = 0
+            self._ripe_waiting = 0
+        for rid in rids:
+            self.wait.trigger(rid, errors.EtcdError(
+                errors.ECODE_RAFT_INTERNAL, cause=why))
 
     # ------------------------------------------------------------------
     # the round
@@ -943,6 +1100,19 @@ class HostEngine:
                            default=0)
             self.frames.send(lead_host, {"t": "prop", "g": g, "hops": hops},
                              _pack_items(items))
+
+        # -- 1b. read plane: pin which parked quorum reads this round's
+        # confirmation covers (reads parking after dispatch could
+        # postdate writes acked above the commit index this round
+        # captures — they wait for their own round; see
+        # MultiEngine.run_round).
+        read_take: Optional[Dict[int, int]] = None
+        if self._reads_waiting:
+            with self._lock:
+                if self._reads_waiting:
+                    read_take = {g: len(self._reads[g])
+                                 for g in self._read_dirty
+                                 if self._reads[g]}
 
         # -- 2. the consensus round: global SPMD collective, or the local
         # full-(G, P) kernel with the mailbox riding frames ---------------
@@ -1090,6 +1260,13 @@ class HostEngine:
         self.l_state, self.l_last, self.l_ring = state, last, ring
         self.l_lead = lead
 
+        # -- 4b. read plane: confirm the snapshotted reads against this
+        # round's readback (ripens them at the captured commit index;
+        # deposed columns fail theirs).
+        if read_take:
+            self._confirm_reads(read_take, state, term, commit, last,
+                                ring)
+
         # -- 5. persist BEFORE the next dispatch (cross-host contract) ----
         if not rec.is_empty():
             self.wal.append(rec)
@@ -1127,6 +1304,8 @@ class HostEngine:
 
         # -- 7. apply + ack locally ---------------------------------------
         self._apply_committed(trigger=True)
+        if self._ripe_waiting:
+            self._serve_ripe_reads()
         self._request_pulls()
 
         self.round_no += 1

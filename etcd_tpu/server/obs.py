@@ -117,6 +117,33 @@ pool_router_requests = metrics.LabeledCounter(
     "Requests the pool router relayed, by owning shard (refused/unknown "
     "route under shard=\"none\").", ("shard",))
 
+# The zero-append read plane (engine._quorum_read): quorum reads leave
+# the etcd_server_proposal_* families entirely — they append nothing —
+# and meter here instead.
+read_index_confirms = metrics.Histogram(
+    "etcd_read_index_confirmations_per_round",
+    "Groups whose ReadIndex quorum confirmation succeeded in one read "
+    "round.", buckets=_COUNT_BUCKETS)
+read_index_parked = metrics.Gauge(
+    "etcd_read_index_parked_reads",
+    "Quorum reads parked on the read plane: awaiting a leadership "
+    "confirmation or the apply cursor reaching their read index.")
+read_index_durations = metrics.Summary(
+    "etcd_read_index_durations_milliseconds",
+    "The latency distributions of quorum reads served by the ReadIndex "
+    "plane (submit to serve).")
+read_index_served = metrics.Counter(
+    "etcd_read_index_reads_total",
+    "Quorum reads served by the ReadIndex plane (zero log entries, zero "
+    "WAL bytes).")
+read_index_failed = metrics.Counter(
+    "etcd_read_index_failed_total",
+    "Quorum reads that timed out before confirmation + apply catch-up.")
+read_index_lease = metrics.Counter(
+    "etcd_read_index_lease_reads_total",
+    "Quorum reads that skipped the confirmation round under a leader "
+    "lease (EngineConfig.read_lease_ms).")
+
 
 # -- flight recorder ---------------------------------------------------------
 
@@ -320,3 +347,9 @@ class EngineObs:
         self.h_ack_wait = ack_gate_wait
         self.c_rounds = rounds_total
         self.c_acked = acked_total
+        self.h_read_confirms = read_index_confirms
+        self.g_read_parked = read_index_parked
+        self.s_read_dur = read_index_durations
+        self.c_reads_served = read_index_served
+        self.c_reads_failed = read_index_failed
+        self.c_reads_lease = read_index_lease

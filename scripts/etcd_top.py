@@ -221,6 +221,28 @@ def render(prev, cur, dt):
         L.append(f"  shard {sh:<4}{ab if ab is not None else '-':>9}"
                  f"    {qd if qd is not None else '-':>5}")
 
+    # The read plane: quorum reads are NOT proposals (zero-append
+    # ReadIndex path) — their rate/latency/parking meter here.
+    rdps = counter_rate(prev, cur, "etcd_read_index_reads_total", dt)
+    parked = gauge(cur, "etcd_read_index_parked_reads")
+    rfailed = gauge(cur, "etcd_read_index_failed_total")
+    leased = counter_rate(prev, cur, "etcd_read_index_lease_reads_total",
+                          dt)
+    cq = _q(prev, cur, "etcd_read_index_confirmations_per_round", 0.99)
+    L.append(f"read plane  reads/s {rdps:8.1f}   parked "
+             f"{parked or 0:5.0f}   lease/s {leased:7.1f}   failed "
+             f"{rfailed or 0:6.0f}   confirms/round p99 "
+             f"{cq if cq is not None else '-'}")
+    # Quantiles of the summary ride the scrape directly (server-side
+    # sliding window, milliseconds).
+    p50 = gauge(cur, "etcd_read_index_durations_milliseconds",
+                (("quantile", "0.5"),))
+    p99 = gauge(cur, "etcd_read_index_durations_milliseconds",
+                (("quantile", "0.99"),))
+    L.append(f"  read latency p50 "
+             f"{'-' if p50 is None else f'{p50:8.2f}ms'}   p99 "
+             f"{'-' if p99 is None else f'{p99:8.2f}ms'}")
+
     rt = label_values(cur, "etcd_pool_router_requests_total", "shard")
     if rt:
         parts = []

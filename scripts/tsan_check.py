@@ -157,6 +157,47 @@ def wal_waiter():
                 wm.wait(10)
         assert min(wal_durable) >= t
 
+# Read-plane shapes (engine read plane, round 9): a confirmer thread
+# publishes per-group read indexes under the watermark condition (the
+# batched heartbeat-quorum confirmation), an applier advances the
+# applied index with set_many batches on the SAME core, and parked
+# reader threads wake when BOTH confirmed and applied cover their read
+# index, then serve straight from the C tree — the zero-append path.
+# The serve races later batches' mutation phase (GIL dropped under the
+# per-Core mutex); the linearizability contract is asserted raw: a
+# reader woken at applied >= its read index must NEVER miss its key.
+read_core = storecore.Core(("/0", "/1"))
+rw = threading.Condition()
+read_state = {"confirmed": 0, "applied": 0}
+READ_BATCHES = 80
+RB_N = 25
+
+def read_applier():
+    for b in range(READ_BATCHES):
+        paths = ["/1/r%d_%d" % (b, i) for i in range(RB_N)]
+        first, last, failed, recs, descs = read_core.set_many(
+            paths, ["v" * 10] * RB_N, 5.0, False)
+        assert failed == 0, failed
+        with rw:
+            read_state["applied"] = b + 1
+            rw.notify_all()
+
+def read_confirmer():
+    for b in range(READ_BATCHES):
+        with rw:
+            read_state["confirmed"] = b + 1
+            rw.notify_all()
+
+def parked_reader(tid):
+    for want in range(1 + tid, READ_BATCHES + 1, 3):
+        with rw:
+            while not (read_state["confirmed"] >= want
+                       and read_state["applied"] >= want):
+                rw.wait(10)
+        # No try/except: a miss here is a stale serve, not noise.
+        nd, _idx = read_core.get("/1/r%d_0" % (want - 1), False, False)
+        assert nd[0] == "/1/r%d_0" % (want - 1), nd
+
 # Observability-plane shapes (obs.py): the lock-light histogram's
 # observe() is two plain increments racing a scraper's samples() pass,
 # and the flight ring's SUBMITTED mark rebinds whole rows under readers
@@ -204,6 +245,10 @@ ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
          for k in range(WS)]
       + [threading.Thread(target=wal_submitter),
          threading.Thread(target=wal_waiter)]
+      + [threading.Thread(target=read_applier),
+         threading.Thread(target=read_confirmer)]
+      + [threading.Thread(target=parked_reader, args=(t,))
+         for t in range(3)]
       + [threading.Thread(target=hist_observer, args=(t,))
          for t in range(HIST_T)]
       + [threading.Thread(target=hist_scraper),
@@ -217,6 +262,8 @@ if thread_errors:
     print("TSAN-CHILD-THREAD-ERRORS:", thread_errors[:3])
     sys.exit(3)
 assert min(wal_durable) == WAL_TICKETS, wal_durable
+assert read_state["applied"] == READ_BATCHES, read_state
+assert read_core.index == READ_BATCHES * RB_N, read_core.index
 # Lock-light loss bound: single counts may drop under the race, but
 # the cells are monotone — never MORE than observed, and a total wipe
 # would mean the increments aliased, not raced.
@@ -290,8 +337,9 @@ def main() -> int:
           "ThreadSanitizer (4 writers + reader + codec threads, 4 shard "
           "appliers via set_many(need=...), 2 same-core set_many "
           "contenders + reader, 3 WAL-writer streams + submitter + "
-          "watermark waiter, 4 histogram observers vs scraper + flight "
-          "ring submitter vs trace reader)")
+          "watermark waiter, read-plane confirmer + applier vs 3 parked "
+          "readers, 4 histogram observers vs scraper + flight ring "
+          "submitter vs trace reader)")
     return 0
 
 

@@ -205,3 +205,101 @@ def test_gate_obs_overhead_within_budget_silent(tmp_path, capsys):
     out = capsys.readouterr()
     assert "PERF REGRESSION" not in out.err
     assert not out.out.strip()
+
+
+# -- round-9 read-plane / watch-storm / expiry-wave columns -------------------
+
+def _mk_artifact9(tmp, scenarios):
+    parsed = {"metric": "commits_per_sec_64_groups_5_peers",
+              "value": 12345.0, "scenario": "uniform", "platform": "cpu",
+              "scenarios": scenarios}
+    with open(os.path.join(str(tmp), "BENCH_r01.json"), "w") as f:
+        json.dump({"parsed": parsed}, f)
+    return parsed
+
+
+def _cur_line9(prev, scenarios):
+    return json.dumps({"metric": prev["metric"], "value": prev["value"],
+                       "scenario": prev["scenario"],
+                       "platform": prev["platform"],
+                       "scenarios": scenarios})
+
+
+_QREAD = {"groups": 64, "commits_per_sec": 240_000.0,
+          "qread_vs_qget": 3.4, "qread_p99_ms": 9.0}
+_STORM = {"watchers": 25_000, "commits_per_sec": 150_000.0,
+          "staleness_p99_ms": 50.0}
+_WAVE = {"groups": 64, "commits_per_sec": 15_000.0,
+         "round_stall_ms": 12.0}
+
+
+def test_gate_flags_qread_ratio_fall_and_tail_rises(tmp_path, capsys):
+    """The read plane's advantage ratio gates a >20% FALL (drifting back
+    toward the propose path's cost is a regression even at held
+    throughput); the lower-better tails gate a >25% RISE across all
+    three round-9 scenarios."""
+    bench = _load_bench()
+    prev = _mk_artifact9(tmp_path, {"qread": _QREAD, "watch_storm": _STORM,
+                                    "expiry_wave": _WAVE})
+    cur = {"qread": dict(_QREAD, qread_vs_qget=2.1, qread_p99_ms=14.0),
+           "watch_storm": dict(_STORM, staleness_p99_ms=90.0),
+           "expiry_wave": dict(_WAVE, round_stall_ms=40.0)}
+    bench._regression_gate(_cur_line9(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" in out.err
+    emitted = json.loads(out.out.strip().splitlines()[-1])
+    flagged = {f["scenario"] for f in emitted["perf_regressions"]}
+    assert flagged == {"qread.qread_vs_qget", "qread.qread_p99_ms",
+                       "watch_storm.staleness_p99_ms",
+                       "expiry_wave.round_stall_ms"}
+    fall = [f for f in emitted["perf_regressions"]
+            if f["scenario"] == "qread.qread_vs_qget"][0]
+    assert fall["now"] == 2.1 and fall["drop_pct"] > 20
+
+
+def test_gate_qread_throughput_rides_generic_column(tmp_path, capsys):
+    """qread's reads/s lands in commits_per_sec like every scenario's
+    headline — the generic >20% drop rule covers it with no extra
+    wiring."""
+    bench = _load_bench()
+    prev = _mk_artifact9(tmp_path, {"qread": _QREAD})
+    bench._regression_gate(
+        _cur_line9(prev, {"qread": dict(_QREAD,
+                                        commits_per_sec=120_000.0)}),
+        artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" in out.err
+    emitted = json.loads(out.out.strip().splitlines()[-1])
+    assert {f["scenario"] for f in emitted["perf_regressions"]} \
+        == {"qread"}
+
+
+def test_gate_watcher_count_change_not_comparable(tmp_path, capsys):
+    """watch_storm's geometry is the watcher count: a 25k -> 100k sweep
+    is a different workload, never a staleness regression."""
+    bench = _load_bench()
+    prev = _mk_artifact9(tmp_path, {"watch_storm": _STORM})
+    cur = {"watch_storm": dict(_STORM, watchers=100_000,
+                               commits_per_sec=90_000.0,
+                               staleness_p99_ms=200.0)}
+    bench._regression_gate(_cur_line9(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert "not comparable" in out.err
+
+
+def test_gate_read_columns_absent_in_old_artifact_silent(tmp_path, capsys):
+    """Artifacts that predate the read plane carry none of the round-9
+    scenarios or columns — the gate must stay silent, not misfire."""
+    bench = _load_bench()
+    prev = _mk_artifact(tmp_path, _BASE)
+    bench._regression_gate(
+        _cur_line9(prev, {"engine": {"groups": 64, **_BASE},
+                          "qread": _QREAD, "watch_storm": _STORM,
+                          "expiry_wave": _WAVE}),
+        artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert not out.out.strip()

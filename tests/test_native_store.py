@@ -292,3 +292,45 @@ def test_set_applied_lazy_defers_event_materialization(monkeypatch):
     assert not isinstance(r2, LazyWriteEvent)
     got = w.next_event(timeout=1.0)
     assert got is not None and got.node.value == "v2"
+
+
+def test_history_wraparound_since_before_window_differential():
+    """Ring-wraparound scan with `since` OLDER than the retained window:
+    both histories must raise 401 EventIndexCleared (reference
+    event_history.go:58-105) — the C facade used to silently return the
+    oldest retained event instead, masking the evicted span from a
+    watcher resuming with a stale waitIndex. In-window scans must agree
+    event-for-event across the wrap."""
+    cap = 8
+    py = Store(cap, Clock())
+    na = NativeStore(cap, Clock())
+    n = cap * 3  # wrap the ring twice over
+    for st in (py, na):
+        for i in range(n):
+            st.set(f"/w/k{i % 4}", value=str(i))
+
+    for st, name in ((py, "python"), (na, "native")):
+        h = st.watcher_hub.event_history
+        assert h.last_index == n, name
+        assert h.start_index == n - cap + 1, name
+        with pytest.raises(errors.EtcdError) as ei:
+            h.scan("/w/k0", False, h.start_index - 1)
+        assert ei.value.code == errors.ECODE_EVENT_INDEX_CLEARED, name
+        assert ei.value.index == h.last_index, name
+        # The user-visible surface: a watch resuming at the stale index
+        # gets the same 401 instead of a silently-skipped span.
+        with pytest.raises(errors.EtcdError) as ei:
+            st.watch("/w/k0", since_index=h.start_index - 1)
+        assert ei.value.code == errors.ECODE_EVENT_INDEX_CLEARED, name
+
+    # In-window differential: every retained since-index returns the
+    # same event (or the same absence) from both rings.
+    hp = py.watcher_hub.event_history
+    hn = na.watcher_hub.event_history
+    for key, recursive in (("/w/k1", False), ("/w", True)):
+        for since in range(hp.start_index, hp.last_index + 2):
+            ep = hp.scan(key, recursive, since)
+            en = hn.scan(key, recursive, since)
+            assert (ep is None) == (en is None), (key, since)
+            if ep is not None:
+                assert ev_sig(ep) == ev_sig(en), (key, since)

@@ -453,3 +453,57 @@ class TestStats:
         with pytest.raises(errors.EtcdError) as ei:
             s.get("/nope")
         assert ei.value.index == 1
+
+
+class TestExpiryWaveWatchers:
+    def test_mass_expiry_streams_expire_events_in_index_order(self, s,
+                                                              clock):
+        """A mass TTL wave (one SYNC apply sweeping the whole heap) must
+        reach live STREAM watchers as one EXPIRE event per key, in
+        etcd-index order, with no gaps and no duplicates — the delete
+        double-walk (ancestor notify + per-removed-path force notify)
+        must not deliver twice, and the wave must not skip keys."""
+        n = 40
+        for i in range(n):
+            s.create(f"/ttl/k{i:02d}", value=str(i),
+                     expire_time=clock.t + 5 + (i % 3))
+        rec = s.watch("/ttl", recursive=True, stream=True)
+        exact = s.watch("/ttl/k07", stream=True)
+
+        clock.t += 60  # every key is now due
+        events = s.delete_expired_keys(clock.t)
+        assert len(events) == n
+        assert all(e.action == EXPIRE for e in events)
+        idxs = [e.etcd_index for e in events]
+        assert idxs == sorted(idxs), "wave events out of index order"
+        assert len(set(idxs)) == n
+
+        got = [rec.next_event(timeout=1.0) for _ in range(n)]
+        assert all(g is not None and g.action == EXPIRE for g in got)
+        assert [g.etcd_index for g in got] == idxs, \
+            "stream watcher saw the wave out of order or with gaps"
+        assert (sorted(g.node.key for g in got)
+                == [f"/ttl/k{i:02d}" for i in range(n)])
+        assert rec.next_event(timeout=0.05) is None, "duplicate delivery"
+
+        ge = exact.next_event(timeout=1.0)
+        assert ge is not None and ge.action == EXPIRE
+        assert ge.node.key == "/ttl/k07"
+
+    def test_expiry_wave_after_watch_reregister(self, s, clock):
+        """A stream watcher that re-registers MID-wave (at a since index
+        inside the wave) replays the remainder from history in order."""
+        for i in range(6):
+            s.create(f"/ttl/r{i}", value=str(i), expire_time=clock.t + 1)
+        clock.t += 10
+        events = s.delete_expired_keys(clock.t)
+        assert len(events) == 6
+        mid = events[2].etcd_index + 1
+        w = s.watch("/ttl", recursive=True, stream=True, since_index=mid)
+        first = w.next_event(timeout=1.0)
+        # The replay's etcd_index is rewritten to the CURRENT store index
+        # (the X-Etcd-Index watch-response contract); the event identity
+        # rides the node: the first wave event at index >= mid.
+        assert first is not None and first.action == EXPIRE
+        assert first.node.key == "/ttl/r3"
+        assert first.node.modified_index == mid
