@@ -1383,6 +1383,490 @@ def child_main() -> int:
                 "groups": G_x,
                 "fsync": True}
 
+    def measure_shallow_clients(sc_deadline):
+        """The ingress tier's reason to exist (round 10): CONNS
+        concurrent DEPTH-1 clients — each waits for its ack before its
+        next write, the worst shape for a batching engine — measured
+        A/B on the same box against the same engine subprocess (fsync
+        ON): direct-to-engine (thread-per-connection front, one do()
+        per request) vs through the coalescing ingress (epoll front,
+        per-tenant windows flushed as ONE /tenants/{t}/batch ->
+        do_many). Legs interleave direct/ingress/direct/ingress and the
+        LAST ingress leg SIGKILLs the ingress process mid-leg and
+        restarts it — every write acked to a client must still be
+        readable from the engine afterwards (values are per-client
+        monotone seqs, so stored seq >= last acked seq per key is
+        exact). Ends with the hub fan-out phase: W stream watchers of
+        ONE key through the ingress ride a single upstream stream."""
+        import selectors as _selmod
+        import socket as _sock
+        import subprocess as _sp
+        import tempfile
+        import urllib.request as _url
+
+        from etcd_tpu.tools.functional_tester import _free_ports
+
+        CONNS = int(os.environ.get("BENCH_SHALLOW_CONNS", 10_000))
+        T = int(os.environ.get("BENCH_SHALLOW_TENANTS", 8))
+        W_HUB = int(os.environ.get("BENCH_HUB_WATCHERS", 2_000))
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        eport, iport = _free_ports(2)
+        ebase = f"http://127.0.0.1:{eport}"
+        tmp = tempfile.mkdtemp(prefix="bench-shallow-")
+        procs = []
+
+        def boot_engine():
+            p = _sp.Popen(
+                [sys.executable, "-m", "etcd_tpu",
+                 "--engine-groups", str(T), "--engine-peers", "3",
+                 "--data-dir", tmp,
+                 "--listen-client-urls", ebase],
+                env=env, stdout=_sp.DEVNULL, stderr=_sp.DEVNULL)
+            procs.append(p)
+            dl = time.time() + 180
+            while time.time() < dl:
+                try:
+                    with _url.urlopen(f"{ebase}/engine/status",
+                                      timeout=2) as r:
+                        stt = json.loads(r.read())
+                    if stt.get("groups_with_leader") == stt.get("groups"):
+                        return p
+                except Exception:  # noqa: BLE001 — still booting
+                    time.sleep(0.3)
+            raise RuntimeError("shallow_clients: engine never led")
+
+        def boot_ingress():
+            p = _sp.Popen(
+                [sys.executable, "-m", "etcd_tpu.server.ingress",
+                 "--upstream", ebase, "--port", str(iport)],
+                env=env, stdout=_sp.PIPE, stderr=_sp.DEVNULL)
+            p.stdout.readline()            # its ready line
+            procs.append(p)
+            return p
+
+        # -- the depth-1 client harness (event-driven; the bench child
+        # must itself hold CONNS sockets without a thread per client) --
+        # Every leg writes its OWN key namespace (/l{leg}s{cid}) with
+        # per-leg seqs: direct-leg writes that timed out client-side
+        # stay in the engine's queue and commit minutes later under
+        # 10k-thread thrash — on shared keys they would overwrite seqs
+        # a LATER ingress leg acked and read as false "losses".
+        cur = {}    # run_leg installs {"prefix", "next", "acked", ...}
+
+        class _C:
+            __slots__ = ("sock", "cid", "buf", "need", "status", "out",
+                         "seq", "t0", "dead")
+
+            def __init__(self, cid):
+                self.cid = cid
+                self.buf = bytearray()
+                self.out = b""
+                self.need = -1
+                self.seq = -1
+                self.dead = False
+
+        def _connect(port, n, tag):
+            conns = []
+            refused = 0
+            while len(conns) < n:
+                burst = min(96, n - len(conns))
+                for _ in range(burst):
+                    c = _C(len(conns))
+                    s = _sock.socket()
+                    s.settimeout(10.0)
+                    try:
+                        s.connect(("127.0.0.1", port))
+                    except OSError:
+                        refused += 1
+                        if refused > 200:
+                            raise
+                        time.sleep(0.1)
+                        continue
+                    s.setsockopt(_sock.IPPROTO_TCP, _sock.TCP_NODELAY, 1)
+                    s.setblocking(False)
+                    c.sock = s
+                    conns.append(c)
+                # Pace the storm: the direct leg's thread-per-conn front
+                # accepts + spawns at finite speed; overrunning its
+                # backlog just burns the window in SYN retries.
+                time.sleep(0.02)
+            log(f"[shallow_clients] {len(conns)} conns up ({tag})")
+            return conns
+
+        def _send_next(c, selx):
+            c.seq = cur["next"][c.cid]
+            cur["next"][c.cid] += 1
+            body = f"value={c.cid}:{c.seq}"
+            c.out += (
+                f"PUT /tenants/{c.cid % T}/v2/keys/{cur['prefix']}"
+                f"s{c.cid} HTTP/1.1\r\n"
+                f"Host: b\r\nContent-Type: application/"
+                f"x-www-form-urlencoded\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n{body}").encode()
+            c.t0 = time.perf_counter()
+            _flush_out(c, selx)
+
+        def _flush_out(c, selx):
+            try:
+                while c.out:
+                    n = c.sock.send(c.out)
+                    c.out = c.out[n:]
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                c.dead = True
+                return
+            try:
+                selx.modify(c.sock, _selmod.EVENT_READ
+                            | (_selmod.EVENT_WRITE if c.out else 0), c)
+            except (KeyError, ValueError):
+                pass
+
+        def _feed(c):
+            """Consume ONE complete response (depth-1: never more)."""
+            if c.need < 0:
+                i = c.buf.find(b"\r\n\r\n")
+                if i < 0:
+                    return None
+                head = bytes(c.buf[:i]).lower()
+                c.status = int(c.buf[9:12])
+                j = head.find(b"content-length:")
+                clen = 0
+                if j >= 0:
+                    e = head.find(b"\r\n", j)
+                    clen = int(head[j + 15:e if e >= 0 else len(head)])
+                c.need = i + 4 + clen
+            if len(c.buf) < c.need:
+                return None
+            del c.buf[:c.need]
+            c.need = -1
+            return c.status
+
+        def run_leg(leg, port, leg_s, lat, kill_proc=None):
+            """One measured leg. The MEASURE clock starts after the
+            connect storm completes — at 10k conns the direct leg's
+            thread-per-connection front takes minutes just to accept
+            the population, and counting that against the write window
+            would compare connect storms, not write paths. Both modes
+            get identical post-connect windows. Returns the leg's
+            acked/errors/elapsed plus its acked-seq table and the seqs
+            that were in flight when a connection died (the kill leg's
+            audit needs both)."""
+            cur.clear()
+            cur.update(prefix=f"l{leg}", next=[0] * CONNS,
+                       acked=[-1] * CONNS, dead_inflight={})
+            conns = _connect(port, CONNS,
+                             "ingress" if kill_proc is not None
+                             or port == iport else "direct")
+            selx = _selmod.DefaultSelector()
+            for c in conns:
+                selx.register(c.sock, _selmod.EVENT_READ, c)
+                _send_next(c, selx)
+            t_meas = time.time()
+            leg_end = t_meas + leg_s
+            kill_at = t_meas + leg_s / 2.0 if kill_proc is not None \
+                else None
+            acked = errors = 0
+            killed = False
+            dead_pool = []
+            while time.time() < leg_end:
+                if (kill_at is not None and not killed
+                        and time.time() >= kill_at):
+                    kill_proc.kill()       # SIGKILL, mid-leg
+                    kill_proc.wait()
+                    killed = True
+                    boot_ingress()
+                    log("[shallow_clients] ingress SIGKILLed mid-leg "
+                        "and restarted")
+                for key, mask in selx.select(0.2):
+                    c = key.data
+                    if mask & _selmod.EVENT_READ:
+                        try:
+                            data = c.sock.recv(65536)
+                        except (BlockingIOError, InterruptedError):
+                            data = None
+                        except OSError:
+                            data = b""
+                        if data == b"":
+                            c.dead = True
+                        elif data:
+                            c.buf += data
+                            stc = _feed(c)
+                            if stc is not None:
+                                if 200 <= stc < 300:
+                                    acked += 1
+                                    cur["acked"][c.cid] = c.seq
+                                    if acked % 16 == 0:
+                                        lat.append(time.perf_counter()
+                                                   - c.t0)
+                                else:
+                                    errors += 1
+                                _send_next(c, selx)
+                    if not c.dead and (mask & _selmod.EVENT_WRITE):
+                        _flush_out(c, selx)
+                    if c.dead:
+                        # An in-flight write on a dying conn was never
+                        # acked — it must NOT count (and the read-back
+                        # below would catch us if we lied). Its seq IS
+                        # recorded: an unacked write that was inside
+                        # the dead ingress may still commit (the batch
+                        # POST had already left), and linearizability
+                        # lets that pending op take effect any time
+                        # after invocation — even after newer acked
+                        # writes. The audit exempts exactly that seq.
+                        if c.seq > cur["acked"][c.cid]:
+                            cur["dead_inflight"].setdefault(
+                                c.cid, set()).add(c.seq)
+                        try:
+                            selx.unregister(c.sock)
+                        except (KeyError, ValueError):
+                            pass
+                        c.sock.close()
+                        dead_pool.append(c)
+                # Resurrect killed-ingress casualties in small batches.
+                if dead_pool and killed:
+                    batch, dead_pool[:] = dead_pool[:256], dead_pool[256:]
+                    for c in batch:
+                        s = _sock.socket()
+                        s.settimeout(2.0)
+                        try:
+                            s.connect(("127.0.0.1", port))
+                        except OSError:
+                            dead_pool.append(c)
+                            continue
+                        s.setsockopt(_sock.IPPROTO_TCP,
+                                     _sock.TCP_NODELAY, 1)
+                        s.setblocking(False)
+                        c.sock, c.dead = s, False
+                        c.buf.clear()
+                        c.out, c.need = b"", -1
+                        selx.register(s, _selmod.EVENT_READ, c)
+                        _send_next(c, selx)
+            for c in conns:
+                if not c.dead:
+                    try:
+                        selx.unregister(c.sock)
+                    except (KeyError, ValueError):
+                        pass
+                    c.sock.close()
+            selx.close()
+            return (acked, errors, time.time() - t_meas,
+                    cur["acked"], cur["dead_inflight"])
+
+        boot_engine()
+        boot_ingress()
+        # Warm both paths (first quorum round + route caches) before
+        # the clock starts.
+        for t in range(T):
+            with _url.urlopen(_url.Request(
+                    f"{ebase}/tenants/{t}/v2/keys/warm", method="PUT",
+                    data=b"value=w",
+                    headers={"Content-Type":
+                             "application/x-www-form-urlencoded"}),
+                    timeout=30) as r:
+                r.read()
+
+        def _drain_engine(max_s):
+            """Barrier between legs: wait until the engine has no
+            pending proposals. A leg's client-side timeouts leave
+            writes queued in the engine that commit LATER — unfenced,
+            they steal the next leg's capacity and poison the
+            interleave."""
+            dl = time.time() + max_s
+            while time.time() < dl:
+                try:
+                    with _url.urlopen(f"{ebase}/metrics",
+                                      timeout=10) as r:
+                        m = r.read().decode()
+                    pend = next(
+                        (float(ln.rsplit(" ", 1)[1])
+                         for ln in m.splitlines()
+                         if ln.startswith(
+                             "etcd_server_pending_proposal_total")),
+                        0.0)
+                    if pend == 0.0:
+                        return
+                except Exception:  # noqa: BLE001 — engine busy
+                    pass
+                time.sleep(1.0)
+            log("[shallow_clients] drain barrier timed out "
+                f"after {max_s:.0f}s — next leg may share capacity")
+
+        # Four interleaved A/B legs plus a dedicated KILL leg. Each
+        # leg's MEASURE window (post-connect) is an equal share of what
+        # remains of the scenario budget, overridable via
+        # BENCH_SHALLOW_LEG_S — the connect storms themselves (minutes
+        # at 10k conns on the direct leg) ride outside the measured
+        # windows, so a tight budget shrinks the windows rather than
+        # zeroing a leg. The kill leg is excluded from the A/B rates:
+        # half its window is a 10k-reconnect storm by design, so its
+        # "throughput" would measure reconnects; it exists to prove
+        # zero lost acked writes across the SIGKILL.
+        span = max(20.0, (sc_deadline - time.time()) - 25.0)
+        leg_s = float(os.environ.get("BENCH_SHALLOW_LEG_S", "0")) \
+            or max(15.0, span / 5.0)
+        d_acked = d_err = i_acked = i_err = 0
+        d_time = i_time = 0.0
+        d_lat, i_lat = [], []
+        ingress_audits = []        # (leg, acked_tbl, dead_inflight)
+        ingress_proc = procs[-1]
+        for leg, mode in enumerate(("direct", "ingress") * 2):
+            if mode == "direct":
+                a, e, dt, _, _ = run_leg(leg, eport, leg_s, d_lat)
+                d_acked += a
+                d_err += e
+                d_time += dt
+            else:
+                a, e, dt, atbl, dinf = run_leg(leg, iport, leg_s, i_lat)
+                i_acked += a
+                i_err += e
+                i_time += dt
+                ingress_audits.append((leg, atbl, dinf))
+            log(f"[shallow_clients] leg {leg} {mode}: {a} acked "
+                f"({e} errors) in {dt:.1f}s measured")
+            _drain_engine(120.0)
+        kl = 4
+        a, e, dt, atbl, dinf = run_leg(kl, iport, leg_s, [],
+                                       kill_proc=ingress_proc)
+        ingress_proc = procs[-1]
+        ingress_audits.append((kl, atbl, dinf))
+        log(f"[shallow_clients] kill leg: {a} acked ({e} errors) in "
+            f"{dt:.1f}s measured (excluded from rates)")
+        _drain_engine(120.0)
+
+        # Zero-lost-acked-writes audit, per ingress leg: read every
+        # key back from the ENGINE (not the ingress) and compare
+        # against the last seq each client saw acked. Depth-1 +
+        # per-leg keys + per-key monotone seqs make `stored >= acked`
+        # exact — with ONE exemption: a write that was IN FLIGHT when
+        # its connection died unacked may commit after newer acked
+        # writes (its batch had already left the dead ingress;
+        # linearizability places an unacked op anywhere after its
+        # invocation), so `stored == that seq` is a legal final state,
+        # never counted as a loss.
+        lost = 0
+        stored = {}
+        for t in range(T):
+            with _url.urlopen(
+                    f"{ebase}/tenants/{t}/v2/keys/?recursive=true",
+                    timeout=60) as r:
+                for nd in json.loads(r.read())["node"].get("nodes", []):
+                    stored[(t, nd["key"])] = nd.get("value", "")
+        for leg, atbl, dinf in ingress_audits:
+            for cid in range(CONNS):
+                if atbl[cid] < 0:
+                    continue
+                v = stored.get((cid % T, f"/l{leg}s{cid}"), "")
+                got = int(v.split(":")[1]) if ":" in v else -1
+                if got < atbl[cid] and got not in dinf.get(cid, ()):
+                    lost += 1
+        assert lost == 0, (f"{lost} acked writes missing after ingress "
+                           f"SIGKILL — the ack-after-upstream-ack "
+                           f"contract is broken")
+
+        # Hub fan-out phase: W stream watchers of one key through the
+        # ingress; ONE upstream stream serves them all.
+        hub_deliveries = 0
+        hub_events = 8
+        hw_conns = []
+        selx = _selmod.DefaultSelector()
+        for i in range(W_HUB):
+            s = _sock.socket()
+            s.settimeout(10.0)
+            s.connect(("127.0.0.1", iport))
+            s.sendall(b"GET /tenants/0/v2/keys/hub?wait=true&stream="
+                      b"true HTTP/1.1\r\nHost: b\r\n\r\n")
+            s.setblocking(False)
+            hw_conns.append(s)
+            selx.register(s, _selmod.EVENT_READ, bytearray())
+            if i % 96 == 95:
+                time.sleep(0.01)
+        time.sleep(1.0)                    # all subscribed
+        t_hub = time.time()
+        for i in range(hub_events):
+            with _url.urlopen(_url.Request(
+                    f"http://127.0.0.1:{iport}/tenants/0/v2/keys/hub",
+                    method="PUT", data=f"value=h{i}".encode(),
+                    headers={"Content-Type":
+                             "application/x-www-form-urlencoded"}),
+                    timeout=30) as r:
+                r.read()
+        hub_end = time.time() + 20.0
+        want = W_HUB * hub_events
+        while hub_deliveries < want and time.time() < hub_end:
+            for key, _m in selx.select(0.5):
+                try:
+                    data = key.fileobj.recv(65536)
+                except OSError:
+                    data = b""
+                if data:
+                    key.data.extend(data)
+                    n = key.data.count(b'"action"')
+                    if n:
+                        hub_deliveries += n
+                        key.data.clear()
+        hub_elapsed = time.time() - t_hub
+        # Scrape WHILE the watchers are attached: the claim is W live
+        # watchers over N upstream streams, not the post-close state.
+        with _url.urlopen(f"http://127.0.0.1:{iport}/metrics",
+                          timeout=10) as r:
+            mtx = r.read().decode()
+        hub_streams = next(
+            (float(ln.split()[-1]) for ln in mtx.splitlines()
+             if ln.startswith("etcd_ingress_hub_streams")), -1.0)
+        for s in hw_conns:
+            s.close()
+        selx.close()
+
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except _sp.TimeoutExpired:
+                p.kill()
+
+        d_rate = d_acked / d_time if d_time else 0.0
+        i_rate = i_acked / i_time if i_time else 0.0
+        ratio = round(i_rate / d_rate, 2) if d_rate else None
+        dp99 = (round(1000 * float(np.percentile(d_lat, 99)), 3)
+                if d_lat else None)
+        ip50 = (round(1000 * float(np.percentile(i_lat, 50)), 3)
+                if i_lat else None)
+        ip99 = (round(1000 * float(np.percentile(i_lat, 99)), 3)
+                if i_lat else None)
+        hub_rate = hub_deliveries / hub_elapsed if hub_elapsed else 0.0
+        log(f"[shallow_clients] {CONNS} depth-1 conns, {T} tenants, "
+            f"fsync on: direct {d_rate:,.0f} acked/s vs ingress "
+            f"{i_rate:,.0f} acked/s -> {ratio}x (target >= 2x); ingress "
+            f"ack p50 {ip50} p99 {ip99} ms (direct p99 {dp99}); 0 lost "
+            f"acked writes across SIGKILL; hub {W_HUB} watchers x "
+            f"{hub_events} events -> {hub_deliveries} deliveries "
+            f"({hub_rate:,.0f}/s) over {hub_streams:.0f} upstream "
+            f"stream(s)")
+        return {"commits_per_sec": round(i_rate, 1),
+                "direct_acked_per_sec": round(d_rate, 1),
+                "ingress_acked_per_sec": round(i_rate, 1),
+                "ingress_vs_direct": ratio,
+                "ingress_ack_p50_ms": ip50,
+                "ingress_ack_p99_ms": ip99,
+                "direct_ack_p99_ms": dp99,
+                "p50_commit_latency_ms": ip50,
+                "p99_commit_latency_ms": ip99,
+                "hub_fanout": W_HUB,
+                "hub_deliveries": int(hub_deliveries),
+                "hub_deliveries_per_sec": round(hub_rate, 1),
+                "hub_upstream_streams": int(hub_streams),
+                "direct_errors": int(d_err),
+                "ingress_errors": int(i_err),
+                "lost_acked_writes": int(lost),
+                "ingress_sigkilled": True,
+                "conns": CONNS,
+                "tenants": T,
+                "fsync": True}
+
     sel = scenario
     # churn LAST: it boots a second kernel geometry (7 peers, BASELINE
     # config 5) whose compile can eat a cold-cache TPU budget — the
@@ -1392,9 +1876,10 @@ def child_main() -> int:
     # north-star G, latency at the per-chip shard shape) carry the
     # round's headline claims and get real time; zipf/lag are
     # comparatively quick synced loops.
-    _WEIGHTS = {"uniform": 0.22, "zipf": 0.06, "lag": 0.06,
-                "engine": 0.19, "latency": 0.16, "churn": 0.08,
-                "qread": 0.10, "watch_storm": 0.06, "expiry_wave": 0.07}
+    _WEIGHTS = {"uniform": 0.20, "zipf": 0.05, "lag": 0.05,
+                "engine": 0.17, "latency": 0.15, "churn": 0.08,
+                "qread": 0.09, "watch_storm": 0.06, "expiry_wave": 0.06,
+                "shallow_clients": 0.09}
     # Serving scenarios directly after the primary: a time-boxed TPU run
     # (tunnel flakes eat budget) must land the north-star engine/latency
     # numbers before the quick synced loops, and churn stays last (its
@@ -1402,8 +1887,9 @@ def child_main() -> int:
     # expiry scenarios ride between them: qread reuses the engine
     # scenario's compiled geometry family, watch_storm/expiry_wave are
     # host-dominated.
-    order = (["uniform", "engine", "latency", "qread", "watch_storm",
-              "expiry_wave", "zipf", "lag", "churn"]
+    order = (["uniform", "engine", "latency", "qread",
+              "shallow_clients", "watch_storm", "expiry_wave", "zipf",
+              "lag", "churn"]
              if sel == "all" else [sel])
     results = {}
     if (sel == "all" and not on_tpu
@@ -1484,6 +1970,8 @@ def child_main() -> int:
             results[sc]["target_p99_ms"] = 10.0
         elif sc == "qread":
             results[sc] = measure_qread(sc_deadline)
+        elif sc == "shallow_clients":
+            results[sc] = measure_shallow_clients(sc_deadline)
         elif sc == "watch_storm":
             results[sc] = measure_watch_storm(sc_deadline)
         elif sc == "expiry_wave":
@@ -1653,7 +2141,8 @@ def _regression_gate(line: str, artifact_dir=None) -> None:
         geom_keys = {"churn": "peers", "engine": "groups",
                      "latency": "groups", "qread": "groups",
                      "expiry_wave": "groups",
-                     "watch_storm": "watchers"}.get(sc)
+                     "watch_storm": "watchers",
+                     "shallow_clients": "conns"}.get(sc)
         # Geometry tuple: the scenario's own shape key where it has one,
         # the platform (older artifacts carry no per-scenario platform
         # key — fall back to the artifact-level platform on BOTH sides,
@@ -1693,7 +2182,14 @@ def _regression_gate(line: str, artifact_dir=None) -> None:
         for col, lb in (("qread_vs_qget", False),
                         ("qread_p99_ms", True),
                         ("staleness_p99_ms", True),
-                        ("round_stall_ms", True)):
+                        ("round_stall_ms", True),
+                        # Round-10 ingress-tier columns: the coalescing
+                        # advantage ratio gates a >20% fall (an ingress
+                        # drifting back toward direct shallow cost is a
+                        # regression even if absolute acked/s held) and
+                        # the client-observed ack tail a >25% rise.
+                        ("ingress_vs_direct", False),
+                        ("ingress_ack_p99_ms", True)):
             cmp(f"{sc}.{col}", v.get(col), o.get(col), ng, og,
                 lower_better=lb)
         # Instrumentation-overhead budget: the observability plane may

@@ -6,6 +6,11 @@ each tenant group gets the same client API one etcd cluster exposes):
 
     /tenants/{g}/v2/keys/...   full v2 keys CRUD/CAS/CAD/watch (reuses
                                ClientAPI via a per-tenant server adapter)
+    /tenants/{g}/batch         POST a coalesced batch of writes served by
+                               MultiEngine.do_many — the ingress tier's
+                               upstream surface (server/ingress.py); one
+                               HTTP request fans into one deep P_MULTI
+                               log entry and N in-slot results
     /tenants/{g}/status        group consensus status (leader, term,
                                commit, applied, active slots)
     /tenants/{g}/conf          POST {"op": "add"|"remove", "slot": n} —
@@ -241,8 +246,120 @@ class TenantAPI:
             ctx.send_json(200, self.engine.status(g))
         elif rest == "conf":
             self._handle_conf(ctx, g)
+        elif rest == "batch":
+            self._handle_batch(ctx, g)
         else:
             ctx.send_json(404, {"message": f"unknown tenant path {rest!r}"})
+
+    def _handle_batch(self, ctx: Ctx, g: int) -> None:
+        """POST /tenants/{g}/batch — the coalesced write surface the
+        ingress tier (server/ingress.py) ships its flush windows through.
+        Body: {"reqs": [{"method", "path", "value", "ttl", "dir",
+        "prevValue", "prevIndex", "prevExist", "refresh"}, ...]} (or a
+        bare list). The whole batch rides MultiEngine.do_many — one lock
+        acquisition, one deep P_MULTI log entry per max_ents*batch_max
+        window — and every request's outcome comes back IN-SLOT:
+        {"results": [{"status": s, "event": {...}} | {"status": s,
+        "error": {...}}, ...]}, aligned with the request array. A failed
+        CAS or auth denial occupies its slot; it never fails the batch."""
+        from etcd_tpu.etcdhttp.client import trim_prefix
+        from etcd_tpu.server.cluster import STORE_KEYS_PREFIX
+        if ctx.method != "POST":
+            ctx.send(405, b"Method Not Allowed", headers={"Allow": "POST"})
+            return
+        try:
+            body = json.loads(ctx.body.decode() or "{}")
+            raw = body if isinstance(body, list) else body.get("reqs")
+            if not isinstance(raw, list):
+                raise ValueError('body must be {"reqs": [...]} or a list')
+            if not raw:
+                ctx.send_json(200, {"results": []})
+                return
+            reqs = [self._parse_batch_item(d) for d in raw]
+        except errors.EtcdError as e:
+            ctx.send(e.status_code, e.to_json().encode() + b"\n",
+                     "application/json")
+            return
+        except (TypeError, ValueError, KeyError,
+                json.JSONDecodeError) as e:
+            ctx.send_json(400, {"message": f"bad batch body: {e}"})
+            return
+        # Per-request auth against the TENANT's own security handler:
+        # a denied slot carries its 401 downstream, its batch-mates
+        # still commit (the demux contract).
+        sec = self._sec(g)
+        results: list = [None] * len(reqs)
+        admitted, admitted_idx = [], []
+        for i, r in enumerate(reqs):
+            try:
+                sec.check_key_access(ctx, r)
+            except errors.EtcdError as e:
+                results[i] = e
+                continue
+            admitted.append(r)
+            admitted_idx.append(i)
+        if admitted:
+            for i, res in zip(admitted_idx,
+                              self.engine.do_many(g, admitted)):
+                results[i] = res
+        out = []
+        for res in results:
+            if isinstance(res, errors.EtcdError):
+                if res.cause.startswith(STORE_KEYS_PREFIX):
+                    res.cause = res.cause[len(STORE_KEYS_PREFIX):]
+                out.append({"status": res.status_code,
+                            "error": json.loads(res.to_json())})
+            else:
+                d = res.to_dict()
+                created = (d.get("action") == "create"
+                           or (d.get("action") == "set"
+                               and d.get("prevNode") is None))
+                out.append({"status": 201 if created else 200,
+                            "event": trim_prefix(d)})
+        ctx.send_json(200, {"results": out},
+                      {"X-Etcd-Index":
+                       str(self.engine.store(g).current_index)})
+
+    def _parse_batch_item(self, d: dict):
+        """One batch item -> Request (the JSON twin of ClientAPI's
+        parseKeyRequest form fields; TTLs resolve against this server's
+        clock exactly as the per-request path does)."""
+        import posixpath
+        from etcd_tpu.server.cluster import STORE_KEYS_PREFIX
+        from etcd_tpu.server.request import Request
+        if not isinstance(d, dict):
+            raise ValueError("batch item must be an object")
+        method = d.get("method", "PUT")
+        if method not in ("PUT", "POST", "DELETE"):
+            raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                                   cause=f"bad batch method {method!r}")
+        suffix = d.get("path", "")
+        if not isinstance(suffix, str):
+            raise ValueError("path must be a string")
+        p = posixpath.normpath(STORE_KEYS_PREFIX + "/" + suffix.lstrip("/"))
+        if p != STORE_KEYS_PREFIX and \
+                not p.startswith(STORE_KEYS_PREFIX + "/"):
+            raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                                   cause=f"invalid key path {suffix!r}")
+        expiration = None
+        ttl = d.get("ttl")
+        if ttl is not None:
+            ttl = int(ttl)
+            if ttl < 0:
+                raise errors.EtcdError(errors.ECODE_TTL_NAN,
+                                       cause='invalid value for "ttl"')
+            if ttl > 0:
+                expiration = time.time() + ttl
+        prev_exist = d.get("prevExist")
+        if prev_exist is not None:
+            prev_exist = bool(prev_exist)
+        return Request(
+            method=method, path=p, val=str(d.get("value", "")),
+            dir=bool(d.get("dir", False)),
+            prev_value=str(d.get("prevValue", "")),
+            prev_index=int(d.get("prevIndex", 0)),
+            prev_exist=prev_exist, expiration=expiration,
+            refresh=bool(d.get("refresh", False)))
 
     def _handle_security(self, ctx: Ctx, g: int, sub: str) -> None:
         """Per-tenant /v2/security/{roles,users,enable} (reference

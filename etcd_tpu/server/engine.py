@@ -1112,6 +1112,81 @@ class MultiEngine:
             return result.resolve()
         return result
 
+    def do_many(self, g: int, reqs: List[Request],
+                timeout: Optional[float] = None) -> List[Any]:
+        """Serve a BATCH of write requests against group g from one
+        caller (the ingress tier's coalesced submission surface): all of
+        them are enqueued under ONE lock acquisition, so the next round's
+        staging packs them into deep P_MULTI log entries — the exact
+        multi-request packing `do()` traffic already coalesces into, which
+        keeps the WAL format and replay path unchanged (an entry written
+        through this path is indistinguishable from one that coalesced
+        out of N concurrent `do()` calls).
+
+        Returns one result per request, in request order. Application
+        errors (failed CAS, auth, timeout) come back IN-SLOT as EtcdError
+        instances instead of raising — the caller is a demultiplexer that
+        must fan each slot's outcome back to a different waiting client,
+        so one bad request must never poison its batch-mates. Results are
+        only produced after the engine's ack path released the waiters,
+        i.e. after this batch's round is fsync-durable — an ingress crash
+        after `do_many` returns can never lose an acked write."""
+        for r in reqs:
+            if r.method not in (METHOD_PUT, METHOD_POST, METHOD_DELETE,
+                                METHOD_QGET, METHOD_SYNC):
+                raise errors.EtcdError(errors.ECODE_INVALID_FORM,
+                                       cause=f"bad batch method {r.method}")
+        obs_on = self.obs.enabled
+        tr = self.obs.tracer
+        n = len(reqs)
+        items = []
+        queues = []
+        for r in reqs:
+            if r.id == 0:
+                r = Request(**{**r.__dict__, "id": self.reqid.next()})
+            if tr.every:
+                tr.mark(r.id, "submit", g=g)
+            queues.append((r.id, self.wait.register(r.id)))
+            items.append((r.id, bytes([P_REQ]) + r.encode(), r))
+        with self._lock:
+            self._pending[g].extend(items)
+            if items:
+                self._dirty.add(g)
+        if obs_on:
+            for _ in range(n):
+                metrics.propose_pending.inc()
+        t0 = time.perf_counter()
+        deadline = t0 + (timeout or self.cfg.request_timeout)
+        out = []
+        try:
+            for rid, q in queues:
+                try:
+                    result = q.get(
+                        timeout=max(0.0, deadline - time.perf_counter()))
+                except queue.Empty:
+                    if obs_on:
+                        metrics.propose_failed.inc()
+                    self.wait.cancel(rid)
+                    out.append(errors.EtcdError(
+                        errors.ECODE_RAFT_INTERNAL,
+                        cause="request timed out",
+                        index=int(self.applied[g])))
+                    continue
+                if type(result) is LazyWriteEvent:
+                    result = result.resolve()
+                out.append(result)
+        finally:
+            if obs_on:
+                for _ in range(n):
+                    metrics.propose_pending.dec()
+        if obs_on and n:
+            # One batch = one client-visible submission window; the
+            # per-request proposal latency is the window's mean.
+            dt = (time.perf_counter() - t0) * 1000.0 / n
+            for _ in range(n):
+                metrics.propose_durations.observe(dt)
+        return out
+
     # ------------------------------------------------------------------
     # the read plane (batched ReadIndex; zero-append quorum reads)
     # ------------------------------------------------------------------

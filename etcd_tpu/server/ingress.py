@@ -1,0 +1,940 @@
+"""Coalescing ingress tier: manufacture batch depth from shallow clients.
+
+Every headline engine number is measured from deep per-tenant queues,
+but a million-user deployment is the opposite shape: tens of thousands
+of SHALLOW clients, each issuing depth-1 writes, TTL refreshes and
+watches. "Scaling Replicated State Machines with Compartmentalization"
+(PAPERS.md) names the fix — a stateless proxy/batcher role in front of
+the ordering core — and ROADMAP item 2 scopes it for this engine. This
+module is that role:
+
+  * An EVENT-DRIVEN front (one epoll loop, not thread-per-connection)
+    holds tens of thousands of client sockets at a few fds' and one
+    thread's cost — the whole point; a threaded front would burn the
+    same GIL the direct path does and manufacture nothing.
+
+  * A per-tenant COALESCING LANE buffers writes inside an adaptive
+    window and ships each flush upstream as ONE POST /tenants/{t}/batch
+    (etcdhttp/tenants.py -> MultiEngine.do_many -> the existing P_MULTI
+    multi-request log-entry packing, so WAL format and replay are
+    untouched). The window never sleeps: it closes on request count
+    (flush_max_requests), on bytes (flush_max_bytes), or the moment an
+    upstream inflight slot frees while the buffer is non-empty (the
+    "drain" reason) — group commit's natural-batching policy at the
+    tier above the engine.
+
+  * Acks/errors DEMULTIPLEX back to each waiting client only after the
+    upstream ack: the ingress holds no durable state and never
+    acknowledges ahead of the engine's fsync-gated ack, so SIGKILLing
+    an ingress process can lose in-flight (unacked) writes but never an
+    acked one (tests/test_ingress.py proves it across a real SIGKILL).
+
+  * A WATCH FAN-OUT HUB multiplexes N downstream watchers of the same
+    (tenant, key, recursive) onto ONE upstream watch stream, with a
+    small replay ring so late long-polls with a waitIndex inside the
+    ring are served without another upstream round trip.
+
+  * Quorum GETs forward to the PR 9 read plane upstream; with
+    read_lease_ms > 0 the ingress downgrades them to plain local GETs
+    while a lease holds — any upstream quorum-confirmed ack (every
+    batch ack is one: a committed write proves the leader's quorum)
+    within the window renews it. Same clock-bound contract as
+    EngineConfig.read_lease_ms; off by default.
+
+Run one per core (scripts/ingress_serve.py) in front of an engine or a
+pool_serve.py router — the router rewrites /tenants/{t}/batch through
+the same tenant mapping as every other per-tenant path, so ingress and
+process sharding compose unchanged.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import posixpath
+import selectors
+import socket
+import threading
+import time
+import urllib.parse
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from etcd_tpu.server import obs
+
+log = logging.getLogger("etcd_tpu.ingress")
+
+_MAX_HEADER = 64 * 1024
+_MAX_BODY = 4 * 1024 * 1024
+_RING_CAP = 256          # hub replay ring (events per upstream stream)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+@dataclass
+class IngressConfig:
+    upstream: str                      # "http://host:port" (engine or router)
+    host: str = "127.0.0.1"
+    port: int = 0
+    flush_max_requests: int = 1024     # window closes on count...
+    flush_max_bytes: int = 1 << 20     # ...or on encoded bytes...
+    max_inflight: int = 1              # ...or when an inflight slot frees.
+    # max_inflight=1 keeps per-client FIFO strict even for pipelined
+    # writes (batches commit in flush order); depth-1 clients are
+    # order-safe at any setting because they never overlap their own
+    # writes.
+    read_lease_ms: int = 0
+    request_timeout: float = 30.0
+
+
+def _upstream_addr(url: str) -> Tuple[str, int]:
+    u = urllib.parse.urlsplit(url if "//" in url else "//" + url)
+    return u.hostname or "127.0.0.1", int(u.port or 2379)
+
+
+# ---------------------------------------------------------------------------
+# HTTP plumbing (loop side)
+# ---------------------------------------------------------------------------
+
+class _Conn:
+    """One downstream client connection's loop-side state."""
+
+    __slots__ = ("sock", "rbuf", "wbuf", "closing", "streaming",
+                 "want_write", "open", "busy", "subs")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.rbuf = bytearray()
+        self.wbuf = bytearray()
+        self.closing = False       # close after wbuf drains
+        self.streaming = False     # chunked watch stream in progress
+        self.want_write = False
+        self.open = True
+        self.busy = False          # a response is owed; pause parsing
+        self.subs: list = []       # hub subscriptions (for close cleanup)
+
+
+def _response(status: int, body: bytes,
+              ctype: str = "application/json",
+              extra: Optional[Dict[str, str]] = None,
+              close: bool = False) -> bytes:
+    reason = {200: "OK", 201: "Created", 400: "Bad Request",
+              404: "Not Found", 405: "Method Not Allowed",
+              408: "Request Timeout", 500: "Internal Server Error",
+              503: "Service Unavailable"}.get(status, "OK")
+    h = [f"HTTP/1.1 {status} {reason}",
+         f"Content-Type: {ctype}",
+         f"Content-Length: {len(body)}"]
+    for k, v in (extra or {}).items():
+        h.append(f"{k}: {v}")
+    if close:
+        h.append("Connection: close")
+    return ("\r\n".join(h) + "\r\n\r\n").encode() + body
+
+
+def _json_response(status: int, obj,
+                   extra: Optional[Dict[str, str]] = None) -> bytes:
+    return _response(status, json.dumps(obj).encode() + b"\n",
+                     extra=extra)
+
+
+def _chunk(data: bytes) -> bytes:
+    return f"{len(data):x}\r\n".encode() + data + b"\r\n"
+
+
+# ---------------------------------------------------------------------------
+# the coalescing lane (one per tenant)
+# ---------------------------------------------------------------------------
+
+class _PendingWrite:
+    __slots__ = ("conn", "item", "size", "t0")
+
+    def __init__(self, conn: _Conn, item: dict, size: int) -> None:
+        self.conn = conn
+        self.item = item
+        self.size = size
+        self.t0 = time.perf_counter()
+
+
+class _Lane:
+    """Per-tenant coalescing window + its flusher thread(s).
+
+    The flusher never sleeps on a timer: it waits on the condition until
+    the buffer is non-empty AND either a threshold tripped or an
+    upstream inflight slot is free, takes up to the caps, and does the
+    upstream POST synchronously. While that batch is in flight new
+    writes pile into the buffer; the moment the flusher returns it takes
+    them all — upstream latency IS the adaptive window."""
+
+    def __init__(self, ing: "Ingress", tenant: int) -> None:
+        self.ing = ing
+        self.tenant = tenant
+        self.buf: deque = deque()
+        self.bytes = 0
+        self.cv = threading.Condition()
+        self.inflight = 0
+        self.stopped = False
+        self.lease_until = 0.0       # monotonic; quorum-read lease
+        cfg = ing.cfg
+        self.threads = [
+            threading.Thread(target=self._flusher, daemon=True,
+                             name=f"ingress-lane{tenant}-{i}")
+            for i in range(max(1, cfg.max_inflight))]
+        for t in self.threads:
+            t.start()
+
+    def enqueue(self, pw: _PendingWrite) -> None:
+        with self.cv:
+            self.buf.append(pw)
+            self.bytes += pw.size
+            self.cv.notify()
+
+    def stop(self) -> None:
+        with self.cv:
+            self.stopped = True
+            self.cv.notify_all()
+
+    def _take(self) -> Tuple[List[_PendingWrite], str]:
+        """Called under cv with a non-empty buffer and a free slot."""
+        cfg = self.ing.cfg
+        if len(self.buf) >= cfg.flush_max_requests:
+            reason = "count"
+        elif self.bytes >= cfg.flush_max_bytes:
+            reason = "bytes"
+        else:
+            reason = "drain"
+        batch, nbytes = [], 0
+        while (self.buf and len(batch) < cfg.flush_max_requests
+               and nbytes < cfg.flush_max_bytes):
+            pw = self.buf.popleft()
+            batch.append(pw)
+            nbytes += pw.size
+        self.bytes -= nbytes
+        return batch, reason
+
+    def _flusher(self) -> None:
+        upstream: Optional[http.client.HTTPConnection] = None
+        host, port = _upstream_addr(self.ing.cfg.upstream)
+        while True:
+            with self.cv:
+                while not self.stopped and (
+                        not self.buf
+                        or (self.inflight >= self.ing.cfg.max_inflight
+                            and len(self.buf)
+                            < self.ing.cfg.flush_max_requests
+                            and self.bytes
+                            < self.ing.cfg.flush_max_bytes)):
+                    self.cv.wait(0.5)
+                if self.stopped:
+                    return
+                batch, reason = self._take()
+                self.inflight += 1
+            obs.ingress_inflight.inc()
+            obs.ingress_flush_reason.labels(reason).inc()
+            obs.ingress_batch.observe(len(batch))
+            try:
+                upstream = self._flush(upstream, host, port, batch)
+            finally:
+                obs.ingress_inflight.dec()
+                with self.cv:
+                    self.inflight -= 1
+                    self.cv.notify_all()
+
+    def _flush(self, upstream, host, port,
+               batch: List[_PendingWrite]):
+        """One window -> ONE upstream request -> per-client fan-back.
+        Returns the (possibly re-opened) upstream connection. Never
+        raises: an upstream failure becomes a per-client 503 — no
+        retry here, because a batch that died after the upstream read
+        its request MAY have committed, and re-sending it would
+        double-apply POSTs and break CAS chains. The client that never
+        got an ack owns the retry, exactly as with a direct engine."""
+        body = json.dumps(
+            {"reqs": [pw.item for pw in batch]}).encode()
+        path = f"/tenants/{self.tenant}/batch"
+        try:
+            if upstream is None:
+                upstream = http.client.HTTPConnection(
+                    host, port, timeout=self.ing.cfg.request_timeout)
+            upstream.request("POST", path, body=body,
+                             headers={"Content-Type": "application/json"})
+            resp = upstream.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise OSError(f"upstream batch status {resp.status}")
+            results = json.loads(data)["results"]
+            if len(results) != len(batch):
+                raise OSError("upstream batch result count mismatch")
+        except Exception as e:  # noqa: BLE001 — fans back per client
+            try:
+                if upstream is not None:
+                    upstream.close()
+            except OSError:
+                pass
+            obs.ingress_errors.inc(len(batch))
+            err = _json_response(503, {
+                "errorCode": 300, "message": "Raft Internal Error",
+                "cause": f"ingress upstream flush failed: {e}"})
+            for pw in batch:
+                self.ing.post_send(pw.conn, err)
+            return None
+        # The upstream ack is durable (do_many results release after the
+        # round's fsync) — only NOW may any client see its ack.
+        now = time.perf_counter()
+        lease_s = self.ing.cfg.read_lease_ms / 1000.0
+        if lease_s > 0:
+            self.lease_until = time.monotonic() + lease_s
+        for pw, res in zip(batch, results):
+            obs.ingress_ack_ms.observe((now - pw.t0) * 1000.0)
+            if "error" in res:
+                obs.ingress_errors.inc()
+                out = _json_response(res.get("status", 500), res["error"])
+            else:
+                obs.ingress_acked.inc()
+                out = _json_response(res.get("status", 200), res["event"])
+            self.ing.post_send(pw.conn, out)
+        return upstream
+
+
+# ---------------------------------------------------------------------------
+# watch fan-out hub
+# ---------------------------------------------------------------------------
+
+class _HubSub:
+    __slots__ = ("conn", "stream", "since")
+
+    def __init__(self, conn: _Conn, stream: bool, since: int) -> None:
+        self.conn = conn
+        self.stream = stream
+        self.since = since
+
+
+class _HubStream:
+    """One upstream watch stream fanned out to N downstream watchers."""
+
+    def __init__(self, hub: "_Hub", key: tuple) -> None:
+        self.hub = hub
+        self.key = key                     # (tenant, path, recursive)
+        self.subs: List[_HubSub] = []
+        self.ring: deque = deque(maxlen=_RING_CAP)   # (index, bytes)
+        self.stopped = False
+        self.sock: Optional[socket.socket] = None
+        self.thread = threading.Thread(
+            target=self._reader, daemon=True,
+            name=f"ingress-hub-{key[0]}{key[1]}")
+
+    def _reader(self) -> None:
+        ing = self.hub.ing
+        host, port = _upstream_addr(ing.cfg.upstream)
+        t, path, rec = self.key
+        q = f"wait=true&stream=true&recursive={'true' if rec else 'false'}"
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=None)
+            conn.request(
+                "GET", f"/tenants/{t}/v2/keys{path}?{q}")
+            self.sock = conn.sock
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise OSError(f"upstream watch status {resp.status}")
+            while not self.stopped:
+                line = resp.readline()
+                if not line:
+                    raise OSError("upstream watch stream closed")
+                line = line.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                self._deliver(ev, line + b"\n")
+        except Exception as e:  # noqa: BLE001 — fail every sub, not the tier
+            if not self.stopped:
+                log.warning("hub stream %s died: %s", self.key, e)
+            self.hub.drop_stream(self, e)
+
+    def _deliver(self, ev: dict, raw: bytes) -> None:
+        idx = int(ev.get("node", {}).get("modifiedIndex", 0) or 0)
+        ing = self.hub.ing
+        with self.hub.lock:
+            self.ring.append((idx, raw))
+            subs, self.subs = self.subs, []
+            keep = []
+            delivered = 0
+            for s in subs:
+                if not s.conn.open:
+                    continue
+                if s.since and idx and idx < s.since:
+                    keep.append(s)
+                    continue
+                delivered += 1
+                if s.stream:
+                    ing.post_send(s.conn, _chunk(raw))
+                    keep.append(s)
+                else:
+                    ing.post_send(s.conn, _response(
+                        200, raw, extra={"X-Etcd-Index": str(idx)}))
+                    try:
+                        s.conn.subs.remove((self, s))
+                    except ValueError:
+                        pass
+            self.subs = keep + self.subs
+            if not self.subs and not self.stopped:
+                # Last long-poll served: drop the upstream stream too,
+                # or every once-watched key leaks a connection forever.
+                self.hub._close_stream(self)
+            if delivered:
+                obs.ingress_hub_deliveries.inc(delivered)
+                obs.ingress_hub_watchers.set(self.hub.watcher_count())
+
+
+class _Hub:
+    def __init__(self, ing: "Ingress") -> None:
+        self.ing = ing
+        self.lock = threading.Lock()
+        self.streams: Dict[tuple, _HubStream] = {}
+
+    def watcher_count(self) -> int:
+        return sum(len(st.subs) for st in self.streams.values())
+
+    def subscribe(self, conn: _Conn, tenant: int, path: str,
+                  recursive: bool, stream: bool, since: int) -> None:
+        """Attach a downstream watcher; serve from the replay ring when
+        its waitIndex is already covered (no upstream round trip)."""
+        key = (tenant, path, recursive)
+        with self.lock:
+            st = self.streams.get(key)
+            if st is None:
+                st = self.streams[key] = _HubStream(self, key)
+                st.thread.start()
+                obs.ingress_hub_streams.set(len(self.streams))
+            if stream:
+                # Headers first, BEFORE the sub registers — a live
+                # delivery racing in from the reader thread must never
+                # beat the status line onto the wire.
+                self.ing.post_send(conn, (
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n"))
+            if since:
+                ready = [(i, raw) for i, raw in st.ring if i >= since]
+                if ready:
+                    if not stream:
+                        i, raw = ready[0]
+                        self.ing.post_send(conn, _response(
+                            200, raw, extra={"X-Etcd-Index": str(i)}))
+                        if not st.subs:
+                            self._close_stream(st)
+                        return
+                    for _i, raw in ready:
+                        self.ing.post_send(conn, _chunk(raw))
+                    since = 0    # caught up; go live below
+            sub = _HubSub(conn, stream, since)
+            st.subs.append(sub)
+            conn.subs.append((st, sub))
+            obs.ingress_hub_watchers.set(self.watcher_count())
+
+    def unsubscribe_conn(self, conn: _Conn) -> None:
+        with self.lock:
+            for st, sub in conn.subs:
+                try:
+                    st.subs.remove(sub)
+                except ValueError:
+                    pass
+                if not st.subs:
+                    self._close_stream(st)
+            conn.subs.clear()
+            obs.ingress_hub_watchers.set(self.watcher_count())
+
+    def _close_stream(self, st: _HubStream) -> None:
+        st.stopped = True
+        self.streams.pop(st.key, None)
+        obs.ingress_hub_streams.set(len(self.streams))
+        try:
+            if st.sock is not None:
+                st.sock.close()      # unblocks the reader's readline
+        except OSError:
+            pass
+
+    def drop_stream(self, st: _HubStream, err: Exception) -> None:
+        """Upstream stream died: fail every subscriber loudly (a silent
+        hub would turn a dead upstream into watchers that never fire)."""
+        with self.lock:
+            if self.streams.get(st.key) is st:
+                self.streams.pop(st.key, None)
+                obs.ingress_hub_streams.set(len(self.streams))
+            subs, st.subs = st.subs, []
+            for s in subs:
+                if not s.conn.open:
+                    continue
+                if s.stream:
+                    self.ing.post_send(s.conn, b"0\r\n\r\n",
+                                       close_after=True)
+                else:
+                    self.ing.post_send(s.conn, _json_response(
+                        503, {"errorCode": 300,
+                              "message": "Raft Internal Error",
+                              "cause": f"ingress upstream watch died: "
+                                       f"{err}"}))
+                try:
+                    s.conn.subs.remove((st, s))
+                except ValueError:
+                    pass
+            obs.ingress_hub_watchers.set(self.watcher_count())
+
+    def stop(self) -> None:
+        with self.lock:
+            for st in list(self.streams.values()):
+                self._close_stream(st)
+
+
+# ---------------------------------------------------------------------------
+# the ingress server
+# ---------------------------------------------------------------------------
+
+class Ingress:
+    """The event-driven front + lanes + hub + upstream GET forwarders."""
+
+    def __init__(self, cfg: IngressConfig) -> None:
+        self.cfg = cfg
+        self.lanes: Dict[int, _Lane] = {}
+        self._lanes_lock = threading.Lock()
+        self.hub = _Hub(self)
+        self.sel = selectors.DefaultSelector()
+        self._posted: deque = deque()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._stop = threading.Event()
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((cfg.host, cfg.port))
+        self._lsock.listen(4096)
+        self._lsock.setblocking(False)
+        self.port = self._lsock.getsockname()[1]
+        self._thread: Optional[threading.Thread] = None
+        # Small pool for upstream GET forwarding (reads must not block
+        # the loop; they are not coalescable and just proxy through).
+        self._fetchq: deque = deque()
+        self._fetch_cv = threading.Condition()
+        self._fetchers = [
+            threading.Thread(target=self._fetcher, daemon=True,
+                             name=f"ingress-fetch{i}") for i in range(4)]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.sel.register(self._lsock, selectors.EVENT_READ, "accept")
+        self.sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        for t in self._fetchers:
+            t.start()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ingress-loop")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        self.hub.stop()
+        with self._lanes_lock:
+            for lane in self.lanes.values():
+                lane.stop()
+        with self._fetch_cv:
+            self._fetch_cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.cfg.host}:{self.port}"
+
+    # -- cross-thread completion hand-off -----------------------------------
+
+    def post_send(self, conn: _Conn, data: bytes,
+                  close_after: bool = False) -> None:
+        """Queue bytes for a client from ANY thread; the loop owns every
+        socket write (no per-connection locks, no interleaved sends)."""
+        self._posted.append((conn, data, close_after))
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    # -- the loop ------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            for key, mask in self.sel.select(timeout=0.5):
+                tag = key.data
+                if tag == "accept":
+                    self._accept()
+                elif tag == "wake":
+                    try:
+                        self._wake_r.recv(65536)
+                    except OSError:
+                        pass
+                else:
+                    conn: _Conn = tag
+                    if mask & selectors.EVENT_READ:
+                        self._readable(conn)
+                    if conn.open and (mask & selectors.EVENT_WRITE):
+                        self._flush_wbuf(conn)
+            self._drain_posted()
+        # teardown
+        for key in list(self.sel.get_map().values()):
+            if isinstance(key.data, _Conn):
+                self._close(key.data)
+        try:
+            self.sel.unregister(self._lsock)
+            self.sel.unregister(self._wake_r)
+        except (KeyError, ValueError):
+            pass
+        self._lsock.close()
+        self._wake_r.close()
+        self._wake_w.close()
+        self.sel.close()
+
+    def _drain_posted(self) -> None:
+        while self._posted:
+            conn, data, close_after = self._posted.popleft()
+            if not conn.open:
+                continue
+            conn.busy = False
+            conn.wbuf += data
+            if close_after:
+                conn.closing = True
+                conn.streaming = False   # the stream just ended
+            self._flush_wbuf(conn)
+            # A pipelined request may already be buffered.
+            if conn.open and not conn.busy and not conn.streaming:
+                self._parse(conn)
+
+    def _accept(self) -> None:
+        for _ in range(256):
+            try:
+                s, _addr = self._lsock.accept()
+            except (BlockingIOError, OSError):
+                return
+            s.setblocking(False)
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _Conn(s)
+            self.sel.register(s, selectors.EVENT_READ, conn)
+
+    def _close(self, conn: _Conn) -> None:
+        if not conn.open:
+            return
+        conn.open = False
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn.subs:
+            self.hub.unsubscribe_conn(conn)
+
+    def _readable(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close(conn)
+            return
+        if not data:
+            self._close(conn)
+            return
+        conn.rbuf += data
+        if not conn.busy and not conn.streaming:
+            self._parse(conn)
+
+    def _flush_wbuf(self, conn: _Conn) -> None:
+        try:
+            while conn.wbuf:
+                n = conn.sock.send(conn.wbuf)
+                if n <= 0:
+                    break
+                del conn.wbuf[:n]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close(conn)
+            return
+        events = selectors.EVENT_READ
+        if conn.wbuf:
+            events |= selectors.EVENT_WRITE
+        elif conn.closing and not conn.streaming:
+            # A streaming watcher that asked Connection: close still
+            # holds the stream open until it ends (0-chunk or hangup).
+            self._close(conn)
+            return
+        try:
+            self.sel.modify(conn.sock, events, conn)
+        except (KeyError, ValueError):
+            pass
+
+    # -- HTTP parse + dispatch ----------------------------------------------
+
+    def _parse(self, conn: _Conn) -> None:
+        while conn.open and not conn.busy:
+            end = conn.rbuf.find(b"\r\n\r\n")
+            if end < 0:
+                if len(conn.rbuf) > _MAX_HEADER:
+                    conn.wbuf += _json_response(400, {
+                        "message": "headers too large"})
+                    conn.closing = True
+                    self._flush_wbuf(conn)
+                return
+            head = bytes(conn.rbuf[:end]).decode("latin-1")
+            lines = head.split("\r\n")
+            try:
+                method, target, _ver = lines[0].split(" ", 2)
+            except ValueError:
+                self._close(conn)
+                return
+            headers = {}
+            for ln in lines[1:]:
+                k, _, v = ln.partition(":")
+                headers[k.strip().lower()] = v.strip()
+            clen = int(headers.get("content-length", "0") or "0")
+            if clen > _MAX_BODY:
+                conn.wbuf += _json_response(400, {"message": "body too "
+                                                             "large"})
+                conn.closing = True
+                self._flush_wbuf(conn)
+                return
+            if len(conn.rbuf) < end + 4 + clen:
+                return
+            body = bytes(conn.rbuf[end + 4:end + 4 + clen])
+            del conn.rbuf[:end + 4 + clen]
+            if headers.get("connection", "").lower() == "close":
+                conn.closing = True
+            conn.busy = True
+            self._dispatch(conn, method, target, headers, body)
+
+    def _reply(self, conn: _Conn, data: bytes) -> None:
+        """Loop-thread synchronous reply to the CURRENT request."""
+        conn.busy = False
+        conn.wbuf += data
+        self._flush_wbuf(conn)
+
+    def _dispatch(self, conn: _Conn, method: str, target: str,
+                  headers: Dict[str, str], body: bytes) -> None:
+        path, _, query = target.partition("?")
+        params = urllib.parse.parse_qs(query, keep_blank_values=True)
+        if body and headers.get("content-type", "").startswith(
+                "application/x-www-form-urlencoded"):
+            for k, v in urllib.parse.parse_qs(
+                    body.decode("latin-1"),
+                    keep_blank_values=True).items():
+                params[k] = v
+
+        def p(name: str, default: str = "") -> str:
+            v = params.get(name)
+            return v[0] if v else default
+
+        if path == "/health":
+            self._reply(conn, _json_response(200, {"health": "true"}))
+            return
+        if path == "/metrics":
+            self._reply(conn, self._metrics_response())
+            return
+        parts = path.split("/", 3)
+        if len(parts) >= 3 and parts[1] == "tenants" and parts[2]:
+            try:
+                tenant = int(parts[2])
+            except ValueError:
+                self._reply(conn, _json_response(
+                    404, {"message": f"no such tenant {parts[2]!r}"}))
+                return
+            rest = "/" + (parts[3] if len(parts) > 3 else "")
+            if rest.startswith("/v2/keys"):
+                key = rest[len("/v2/keys"):] or "/"
+                key = posixpath.normpath("/" + key.lstrip("/"))
+                if method in ("PUT", "POST", "DELETE"):
+                    self._handle_write(conn, tenant, method, key, p)
+                    return
+                if method == "GET":
+                    if p("wait") == "true":
+                        self.hub.subscribe(
+                            conn, tenant, key,
+                            p("recursive") == "true",
+                            p("stream") == "true",
+                            int(p("waitIndex") or 0))
+                        if p("stream") == "true":
+                            conn.streaming = True
+                        return
+                    self._forward(conn, tenant, method, target)
+                    return
+        # Everything else (status, stats, engine surfaces) proxies
+        # through unchanged — the ingress is transparent for them.
+        self._forward(conn, None, method, target, body=body)
+
+    def _handle_write(self, conn: _Conn, tenant: int, method: str,
+                      key: str, p) -> None:
+        item = {"method": method, "path": key}
+        if p("value"):
+            item["value"] = p("value")
+        if p("ttl"):
+            try:
+                item["ttl"] = int(p("ttl"))
+            except ValueError:
+                self._reply(conn, _json_response(400, {
+                    "errorCode": 202,
+                    "message": "The given TTL in POST form is not a "
+                               "number"}))
+                return
+        if p("dir") == "true":
+            item["dir"] = True
+        if p("refresh") == "true":
+            item["refresh"] = True
+        if p("prevValue"):
+            item["prevValue"] = p("prevValue")
+        if p("prevIndex"):
+            try:
+                item["prevIndex"] = int(p("prevIndex"))
+            except ValueError:
+                self._reply(conn, _json_response(400, {
+                    "errorCode": 203,
+                    "message": "The given index in POST form is not a "
+                               "number"}))
+                return
+        if p("prevExist"):
+            item["prevExist"] = p("prevExist") == "true"
+        size = sum(len(k) + len(str(v)) + 8 for k, v in item.items())
+        self.lane(tenant).enqueue(_PendingWrite(conn, item, size))
+
+    def lane(self, tenant: int) -> _Lane:
+        lane = self.lanes.get(tenant)
+        if lane is None:
+            with self._lanes_lock:
+                lane = self.lanes.get(tenant)
+                if lane is None:
+                    lane = self.lanes[tenant] = _Lane(self, tenant)
+        return lane
+
+    def _metrics_response(self) -> bytes:
+        from etcd_tpu.utils.metrics import REGISTRY, fd_usage
+        used, limit = fd_usage()
+        extra = (
+            "# HELP process_open_fds Number of open file descriptors.\n"
+            "# TYPE process_open_fds gauge\n"
+            f"process_open_fds {float(used)}\n"
+            "# HELP process_max_fds Maximum number of open file "
+            "descriptors.\n"
+            "# TYPE process_max_fds gauge\n"
+            f"process_max_fds {float(limit)}\n")
+        return _response(200, (REGISTRY.expose() + extra).encode(),
+                         ctype="text/plain; version=0.0.4")
+
+    # -- upstream GET / passthrough forwarding --------------------------------
+
+    def _forward(self, conn: _Conn, tenant: Optional[int], method: str,
+                 target: str, body: bytes = b"") -> None:
+        """Proxy a non-coalescable request upstream on a fetcher thread.
+        Quorum GETs may be downgraded to local GETs under the lane's
+        read lease (renewed by every upstream batch ack — a committed
+        write proves the leader held quorum at ack time)."""
+        if (tenant is not None and "quorum=true" in target
+                and self.cfg.read_lease_ms > 0):
+            lane = self.lane(tenant)
+            if time.monotonic() < lane.lease_until:
+                target = target.replace("quorum=true", "quorum=false")
+                obs.ingress_lease_reads.inc()
+        with self._fetch_cv:
+            self._fetchq.append((conn, tenant, method, target, body))
+            self._fetch_cv.notify()
+
+    def _fetcher(self) -> None:
+        upstream: Optional[http.client.HTTPConnection] = None
+        host, port = _upstream_addr(self.cfg.upstream)
+        while True:
+            with self._fetch_cv:
+                while not self._fetchq and not self._stop.is_set():
+                    self._fetch_cv.wait(0.5)
+                if self._stop.is_set():
+                    return
+                conn, tenant, method, target, body = \
+                    self._fetchq.popleft()
+            if not conn.open:
+                continue
+            try:
+                if upstream is None:
+                    upstream = http.client.HTTPConnection(
+                        host, port, timeout=self.cfg.request_timeout)
+                upstream.request(method, target, body=body or None)
+                resp = upstream.getresponse()
+                data = resp.read()
+                hdrs = {k: v for k, v in resp.getheaders()
+                        if k.lower().startswith("x-etcd")
+                        or k.lower().startswith("x-raft")}
+                ctype = resp.getheader("Content-Type",
+                                       "application/json")
+                if (tenant is not None and resp.status == 200
+                        and "quorum=true" in target
+                        and self.cfg.read_lease_ms > 0):
+                    # A served quorum read is itself a leadership proof.
+                    self.lane(tenant).lease_until = (
+                        time.monotonic()
+                        + self.cfg.read_lease_ms / 1000.0)
+                self.post_send(conn, _response(resp.status, data,
+                                               ctype=ctype, extra=hdrs))
+            except Exception as e:  # noqa: BLE001 — per-request fan-back
+                try:
+                    if upstream is not None:
+                        upstream.close()
+                except OSError:
+                    pass
+                upstream = None
+                self.post_send(conn, _json_response(503, {
+                    "errorCode": 300, "message": "Raft Internal Error",
+                    "cause": f"ingress upstream fetch failed: {e}"}))
+
+
+# ---------------------------------------------------------------------------
+# CLI: one ingress process (scripts/ingress_serve.py runs N of these)
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        description="coalescing ingress tier (one process)")
+    ap.add_argument("--upstream", required=True,
+                    help="engine front or pool router base URL")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--flush-max-requests", type=int, default=1024)
+    ap.add_argument("--flush-max-bytes", type=int, default=1 << 20)
+    ap.add_argument("--max-inflight", type=int, default=1)
+    ap.add_argument("--read-lease-ms", type=int, default=0)
+    args = ap.parse_args(argv)
+    ing = Ingress(IngressConfig(
+        upstream=args.upstream, host=args.host, port=args.port,
+        flush_max_requests=args.flush_max_requests,
+        flush_max_bytes=args.flush_max_bytes,
+        max_inflight=args.max_inflight,
+        read_lease_ms=args.read_lease_ms))
+    ing.start()
+    print(json.dumps({"port": ing.port, "pid": os.getpid(),
+                      "upstream": args.upstream}), flush=True)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    ing.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main(sys.argv[1:]))

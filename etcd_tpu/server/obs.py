@@ -144,6 +144,55 @@ read_index_lease = metrics.Counter(
     "Quorum reads that skipped the confirmation round under a leader "
     "lease (EngineConfig.read_lease_ms).")
 
+# The coalescing ingress tier (server/ingress.py): a stateless front
+# process that buffers shallow per-tenant writes inside an adaptive
+# window and ships each flush upstream as ONE /tenants/{t}/batch
+# request. These families meter the manufactured batch depth (the whole
+# point of the tier), why each window closed, how many batches are in
+# flight upstream, and the watch fan-out hub. Module-level like the rest
+# so the ingress process just imports and observes.
+ingress_batch = metrics.Histogram(
+    "etcd_ingress_coalesce_batch_requests",
+    "Client writes coalesced into one upstream batch flush (the depth "
+    "the ingress manufactured from shallow clients).",
+    buckets=_COUNT_BUCKETS)
+ingress_flush_reason = metrics.LabeledCounter(
+    "etcd_ingress_flush_reason_total",
+    "Why a coalescing window closed: count (flush_max_requests hit), "
+    "bytes (flush_max_bytes hit), or drain (upstream inflight slot "
+    "freed with a non-empty buffer).", ("reason",))
+ingress_inflight = metrics.Gauge(
+    "etcd_ingress_upstream_inflight_batches",
+    "Coalesced batches currently in flight to the upstream engine.")
+ingress_acked = metrics.Counter(
+    "etcd_ingress_acked_requests_total",
+    "Client writes acked by the ingress AFTER the upstream batch ack "
+    "(never before — an ingress crash cannot lose an acked write).")
+ingress_errors = metrics.Counter(
+    "etcd_ingress_upstream_errors_total",
+    "Client writes failed back because their upstream flush errored "
+    "(connection loss, non-200 batch response).")
+ingress_ack_ms = metrics.Summary(
+    "etcd_ingress_ack_milliseconds",
+    "Client-observed write ack latency through the ingress (enqueue "
+    "into the coalescing window -> upstream-acked fan-back).")
+ingress_hub_watchers = metrics.Gauge(
+    "etcd_ingress_hub_watchers",
+    "Downstream watchers currently multiplexed onto upstream watch "
+    "streams by the fan-out hub.")
+ingress_hub_streams = metrics.Gauge(
+    "etcd_ingress_hub_streams",
+    "Upstream watch streams the hub holds open (one per live "
+    "(tenant, prefix, recursive) key).")
+ingress_hub_deliveries = metrics.Counter(
+    "etcd_ingress_hub_deliveries_total",
+    "Events fanned out to downstream watchers by the hub (one upstream "
+    "event delivered to N watchers counts N).")
+ingress_lease_reads = metrics.Counter(
+    "etcd_ingress_lease_reads_total",
+    "Quorum GETs the ingress downgraded to plain local GETs under its "
+    "read lease (a quorum-confirmed upstream ack within read_lease_ms).")
+
 
 # -- flight recorder ---------------------------------------------------------
 

@@ -251,6 +251,41 @@ def render(prev, cur, dt):
                              dt, (("shard", sh),))
             parts.append(f"{sh}:{r:.1f}/s")
         L.append("router      " + "  ".join(parts))
+
+    # The ingress tier (point etcd_top at an ingress process's
+    # /metrics): coalescing window shape, upstream pressure, hub fan-out.
+    if gauge(cur, "etcd_ingress_coalesce_batch_requests_count") is not None:
+        iaps = counter_rate(prev, cur, "etcd_ingress_acked_requests_total",
+                            dt)
+        ierr = counter_rate(prev, cur,
+                            "etcd_ingress_upstream_errors_total", dt)
+        infl = gauge(cur, "etcd_ingress_upstream_inflight_batches")
+        bq = _q(prev, cur, "etcd_ingress_coalesce_batch_requests", 0.99)
+        ilease = counter_rate(prev, cur,
+                              "etcd_ingress_lease_reads_total", dt)
+        L.append(f"ingress     acked/s {iaps:8.1f}   errors/s "
+                 f"{ierr:6.1f}   inflight {infl or 0:3.0f}   batch p99 "
+                 f"{bq if bq is not None else '-':>6}   lease/s "
+                 f"{ilease:7.1f}")
+        reasons = []
+        for rsn in label_values(cur, "etcd_ingress_flush_reason_total",
+                                "reason"):
+            r = counter_rate(prev, cur, "etcd_ingress_flush_reason_total",
+                             dt, (("reason", rsn),))
+            reasons.append(f"{rsn}:{r:.1f}/s")
+        a50 = gauge(cur, "etcd_ingress_ack_milliseconds",
+                    (("quantile", "0.5"),))
+        a99 = gauge(cur, "etcd_ingress_ack_milliseconds",
+                    (("quantile", "0.99"),))
+        L.append(f"  flush {'  '.join(reasons) or '-'}   ack p50 "
+                 f"{'-' if a50 is None else f'{a50:7.2f}ms'}   p99 "
+                 f"{'-' if a99 is None else f'{a99:7.2f}ms'}")
+        hw = gauge(cur, "etcd_ingress_hub_watchers")
+        hs = gauge(cur, "etcd_ingress_hub_streams")
+        hd = counter_rate(prev, cur, "etcd_ingress_hub_deliveries_total",
+                          dt)
+        L.append(f"  hub watchers {hw or 0:6.0f}   upstream streams "
+                 f"{hs or 0:4.0f}   deliveries/s {hd:8.1f}")
     return L
 
 

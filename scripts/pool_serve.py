@@ -11,7 +11,12 @@ id and proxies to the owning shard (watch long-polls are piped through
 unbuffered, with no read timeout). A shard process dying takes down
 only its own tenants (503 with a Retry-After; the others keep serving)
 — the pool is K independent failure domains, exactly like running K
-separate etcd clusters behind a front. Scope: PER-TENANT paths and
+separate etcd clusters behind a front. The coalesced write surface
+POST /tenants/{t}/batch (etcdhttp/tenants.py) rides the same generic
+per-tenant rewrite as every other /tenants/{t}/... path, so an ingress
+tier (server/ingress.py) pointed at the router Just Works: each flush
+lands whole on the shard owning its tenant — a batch never spans
+shards because a lane never spans tenants. Scope: PER-TENANT paths and
 /health only; pool-level surfaces (tenant lifecycle, pool listing) are
 refused with 501 and run against shard ports directly — one shard
 answering for the pool would misreport it.

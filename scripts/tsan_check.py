@@ -235,6 +235,78 @@ def flight_reader():
             if ev["ph"] == "X":
                 assert ev["dur"] >= 0, ev
 
+# Ingress-tier shapes (server/ingress.py): shallow submitter threads
+# append pending writes to a per-tenant lane under its condition (the
+# coalescing window — flush on count or drain, never a timer); the
+# lane's flusher drains the window, encodes the batch through
+# walcodec.pack_multi (the SAME C packing the engine's staging uses on
+# the flushed entry), then releases each submitter's ack slot ONLY
+# after the whole batch "upstream ack" — the ack-after-upstream-ack
+# demux contract. A hub reader concurrently fans events into
+# subscriber drains under the hub lock, racing the histogram scraper
+# above through the shared registry idiom.
+ING_SUBMITTERS, ING_WRITES, ING_FLUSH_MAX = 4, 300, 16
+ing_cv = threading.Condition()
+ing_buf = []
+ing_state = {"open": True}
+ing_acks = [0] * ING_SUBMITTERS
+ing_ack_cv = threading.Condition()
+ing_hist = Histogram("tsan_ingress_batch", "tsan", registry=Registry())
+
+def ingress_submitter(tid):
+    for i in range(ING_WRITES):
+        with ing_cv:
+            ing_buf.append((tid, i, b"\x00" + b"p" * (10 + i % 5)))
+            ing_cv.notify()
+        with ing_ack_cv:
+            while ing_acks[tid] < i + 1:
+                ing_ack_cv.wait(10)
+
+def ingress_flusher():
+    served = 0
+    total = ING_SUBMITTERS * ING_WRITES
+    while served < total:
+        with ing_cv:
+            while not ing_buf:
+                ing_cv.wait(10)
+            batch, ing_buf[:] = ing_buf[:ING_FLUSH_MAX], \
+                ing_buf[ING_FLUSH_MAX:]
+        # One flush window -> ONE deep packed entry (C under threads).
+        blob = walcodec.pack_multi([(1, pl) for _, _, pl in batch], 2)
+        assert blob
+        ing_hist.observe(len(batch))
+        served += len(batch)
+        # Upstream ack for the WHOLE batch lands before ANY per-client
+        # ack releases — the crash-safety ordering the tier guarantees.
+        with ing_ack_cv:
+            for tid, i, _ in batch:
+                assert ing_acks[tid] == i, (tid, i, ing_acks[tid])
+                ing_acks[tid] = i + 1
+            ing_ack_cv.notify_all()
+
+ING_EVENTS, ING_SUBS = 500, 3
+hub_lock = threading.Lock()
+hub_subs = [[] for _ in range(ING_SUBS)]
+hub_done = threading.Event()
+
+def ingress_hub_reader():
+    for i in range(ING_EVENTS):
+        with hub_lock:
+            for q in hub_subs:
+                q.append(i)
+    hub_done.set()
+
+def ingress_hub_sub(sid):
+    got = []
+    while len(got) < ING_EVENTS:
+        with hub_lock:
+            if hub_subs[sid]:
+                got.extend(hub_subs[sid])
+                hub_subs[sid][:] = []
+        if not got and hub_done.is_set() and not hub_subs[sid]:
+            break
+    assert got == list(range(ING_EVENTS)), (sid, len(got))
+
 ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
       + [threading.Thread(target=reader), threading.Thread(target=codec)]
       + [threading.Thread(target=shard_applier, args=(shards[k], k))
@@ -253,7 +325,13 @@ ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
          for t in range(HIST_T)]
       + [threading.Thread(target=hist_scraper),
          threading.Thread(target=flight_submitter),
-         threading.Thread(target=flight_reader)])
+         threading.Thread(target=flight_reader)]
+      + [threading.Thread(target=ingress_submitter, args=(t,))
+         for t in range(ING_SUBMITTERS)]
+      + [threading.Thread(target=ingress_flusher),
+         threading.Thread(target=ingress_hub_reader)]
+      + [threading.Thread(target=ingress_hub_sub, args=(s,))
+         for s in range(ING_SUBS)])
 for t in ts:
     t.start()
 for t in ts:
@@ -262,6 +340,8 @@ if thread_errors:
     print("TSAN-CHILD-THREAD-ERRORS:", thread_errors[:3])
     sys.exit(3)
 assert min(wal_durable) == WAL_TICKETS, wal_durable
+assert min(ing_acks) == ING_WRITES, ing_acks
+assert ing_hist.count > 0 and not ing_buf
 assert read_state["applied"] == READ_BATCHES, read_state
 assert read_core.index == READ_BATCHES * RB_N, read_core.index
 # Lock-light loss bound: single counts may drop under the race, but
@@ -339,7 +419,9 @@ def main() -> int:
           "contenders + reader, 3 WAL-writer streams + submitter + "
           "watermark waiter, read-plane confirmer + applier vs 3 parked "
           "readers, 4 histogram observers vs scraper + flight ring "
-          "submitter vs trace reader)")
+          "submitter vs trace reader, ingress coalescer: 4 depth-1 "
+          "submitters vs lane flusher packing via pack_multi + hub "
+          "reader vs 3 subscriber drains)")
     return 0
 
 

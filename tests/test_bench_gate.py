@@ -290,6 +290,67 @@ def test_gate_watcher_count_change_not_comparable(tmp_path, capsys):
     assert "not comparable" in out.err
 
 
+# -- round-10 coalescing-ingress columns -------------------------------------
+
+_SHALLOW = {"conns": 10_000, "tenants": 8,
+            "commits_per_sec": 2_000.0,
+            "ingress_vs_direct": 2.3,
+            "ingress_ack_p99_ms": 60.0,
+            "lost_acked_writes": 0}
+
+
+def test_gate_flags_ingress_ratio_fall_and_ack_rise(tmp_path, capsys):
+    """shallow_clients gates both directions: the ingress-vs-direct
+    advantage falling >20% (the tier stopped manufacturing batch depth)
+    and the through-ingress ack p99 rising >25% (coalescing latency tax
+    creeping up)."""
+    bench = _load_bench()
+    prev = _mk_artifact9(tmp_path, {"shallow_clients": _SHALLOW})
+    cur = {"shallow_clients": dict(_SHALLOW, ingress_vs_direct=1.5,
+                                   ingress_ack_p99_ms=90.0)}
+    bench._regression_gate(_cur_line9(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" in out.err
+    emitted = json.loads(out.out.strip().splitlines()[-1])
+    flagged = {f["scenario"] for f in emitted["perf_regressions"]}
+    assert flagged == {"shallow_clients.ingress_vs_direct",
+                       "shallow_clients.ingress_ack_p99_ms"}
+    fall = [f for f in emitted["perf_regressions"]
+            if f["scenario"] == "shallow_clients.ingress_vs_direct"][0]
+    assert fall["now"] == 1.5 and fall["drop_pct"] > 20
+
+
+def test_gate_shallow_conns_change_not_comparable(tmp_path, capsys):
+    """shallow_clients' geometry is the connection count: a 10k -> 50k
+    sweep is a different workload, never an ack-latency regression."""
+    bench = _load_bench()
+    prev = _mk_artifact9(tmp_path, {"shallow_clients": _SHALLOW})
+    cur = {"shallow_clients": dict(_SHALLOW, conns=50_000,
+                                   ingress_vs_direct=1.1,
+                                   ingress_ack_p99_ms=400.0)}
+    bench._regression_gate(_cur_line9(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert "not comparable" in out.err
+
+
+def test_gate_ingress_columns_absent_in_old_artifact_silent(
+        tmp_path, capsys):
+    """Artifacts that predate the ingress tier carry no shallow_clients
+    scenario — the gate must stay silent, not misfire."""
+    bench = _load_bench()
+    prev = _mk_artifact(tmp_path, _BASE)
+    bench._regression_gate(
+        _cur_line9(prev, {"engine": {"groups": 64, **_BASE},
+                          "shallow_clients": _SHALLOW}),
+        artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert not out.out.strip()
+
+
 def test_gate_read_columns_absent_in_old_artifact_silent(tmp_path, capsys):
     """Artifacts that predate the read plane carry none of the round-9
     scenarios or columns — the gate must stay silent, not misfire."""

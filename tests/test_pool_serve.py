@@ -59,6 +59,24 @@ def test_pool_sharded_serving(tmp_path):
         for t in range(G):
             assert _get(port, t, "/k")["node"]["value"] == f"v{t}"
 
+        # The coalesced write surface rides the same tenant rewrite:
+        # one batch per tenant, each landing whole on the owning shard
+        # (t=1 -> shard 0, t=G-1 -> shard 1), slot statuses intact.
+        for t in (1, G - 1):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/tenants/{t}/batch",
+                data=json.dumps({"reqs": [
+                    {"method": "PUT", "path": "/b", "value": f"b{t}"},
+                    {"method": "PUT", "path": "/b", "value": "nope",
+                     "prevValue": "wrong"},
+                ]}).encode(), method="POST")
+            req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req, timeout=25) as r:
+                rs = json.loads(r.read())["results"]
+            assert [x["status"] for x in rs] == [201, 412], (t, rs)
+        for t in (1, G - 1):
+            assert _get(port, t, "/b")["node"]["value"] == f"b{t}"
+
         # Out-of-pool tenant id: the router rejects it, not a shard.
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get(port, G + 3, "/k")
