@@ -37,6 +37,20 @@ class _TenantCluster:
         self.cluster_id = g
 
 
+class _BatchSlotCtx:
+    """Ctx facade scoping one batch slot's auth check to the credentials
+    the ingress forwarded for THAT slot. The outer connection belongs to
+    the ingress process, not the client — evaluating every slot against
+    it would collapse all coalesced writers into one anonymous identity
+    and make per-user ACLs unenforceable through the ingress."""
+
+    __slots__ = ("method", "headers")
+
+    def __init__(self, method: str, auth: str) -> None:
+        self.method = method
+        self.headers = {"Authorization": auth}
+
+
 class _TenantServer:
     """Adapts one engine group to the `server` interface ClientAPI drives
     (do/store/clock/stopped/commit_index/term), so the entire keys path —
@@ -255,8 +269,10 @@ class TenantAPI:
         """POST /tenants/{g}/batch — the coalesced write surface the
         ingress tier (server/ingress.py) ships its flush windows through.
         Body: {"reqs": [{"method", "path", "value", "ttl", "dir",
-        "prevValue", "prevIndex", "prevExist", "refresh"}, ...]} (or a
-        bare list). The whole batch rides MultiEngine.do_many — one lock
+        "recursive", "prevValue", "prevIndex", "prevExist", "refresh",
+        "auth"}, ...]} (or a bare list); "auth" is the slot's client's
+        Authorization header value, forwarded so per-user ACLs survive
+        coalescing. The whole batch rides MultiEngine.do_many — one lock
         acquisition, one deep P_MULTI log entry per max_ents*batch_max
         window — and every request's outcome comes back IN-SLOT:
         {"results": [{"status": s, "event": {...}} | {"status": s,
@@ -275,7 +291,13 @@ class TenantAPI:
             if not raw:
                 ctx.send_json(200, {"results": []})
                 return
-            reqs = [self._parse_batch_item(d) for d in raw]
+            reqs, auths = [], []
+            for d in raw:
+                reqs.append(self._parse_batch_item(d))
+                a = d.get("auth")
+                if a is not None and not isinstance(a, str):
+                    raise ValueError('"auth" must be a string')
+                auths.append(a)
         except errors.EtcdError as e:
             ctx.send(e.status_code, e.to_json().encode() + b"\n",
                      "application/json")
@@ -284,15 +306,19 @@ class TenantAPI:
                 json.JSONDecodeError) as e:
             ctx.send_json(400, {"message": f"bad batch body: {e}"})
             return
-        # Per-request auth against the TENANT's own security handler:
+        # Per-request auth against the TENANT's own security handler,
+        # each slot under ITS client's forwarded credentials ("auth"
+        # field; slots without one fall back to the carrying request's):
         # a denied slot carries its 401 downstream, its batch-mates
         # still commit (the demux contract).
         sec = self._sec(g)
         results: list = [None] * len(reqs)
         admitted, admitted_idx = [], []
         for i, r in enumerate(reqs):
+            slot_ctx = _BatchSlotCtx(ctx.method, auths[i]) \
+                if auths[i] else ctx
             try:
-                sec.check_key_access(ctx, r)
+                sec.check_key_access(slot_ctx, r)
             except errors.EtcdError as e:
                 results[i] = e
                 continue
@@ -356,6 +382,7 @@ class TenantAPI:
         return Request(
             method=method, path=p, val=str(d.get("value", "")),
             dir=bool(d.get("dir", False)),
+            recursive=bool(d.get("recursive", False)),
             prev_value=str(d.get("prevValue", "")),
             prev_index=int(d.get("prevIndex", 0)),
             prev_exist=prev_exist, expiration=expiration,

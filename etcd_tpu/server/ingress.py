@@ -32,7 +32,10 @@ module is that role:
   * A WATCH FAN-OUT HUB multiplexes N downstream watchers of the same
     (tenant, key, recursive) onto ONE upstream watch stream, with a
     small replay ring so late long-polls with a waitIndex inside the
-    ring are served without another upstream round trip.
+    ring are served without another upstream round trip. A waitIndex
+    OLDER than the ring's coverage forwards upstream verbatim on a
+    dedicated proxy — history replays (or 401s EventIndexCleared)
+    exactly as on the direct path, never silently skipped.
 
   * Quorum GETs forward to the PR 9 read plane upstream; with
     read_lease_ms > 0 the ingress downgrades them to plain local GETs
@@ -68,6 +71,7 @@ log = logging.getLogger("etcd_tpu.ingress")
 
 _MAX_HEADER = 64 * 1024
 _MAX_BODY = 4 * 1024 * 1024
+_MAX_WBUF = 8 * 1024 * 1024   # slow-client cap: close past this backlog
 _RING_CAP = 256          # hub replay ring (events per upstream stream)
 
 
@@ -104,7 +108,7 @@ class _Conn:
     """One downstream client connection's loop-side state."""
 
     __slots__ = ("sock", "rbuf", "wbuf", "closing", "streaming",
-                 "want_write", "open", "busy", "subs")
+                 "want_write", "open", "busy", "subs", "fwd")
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
@@ -116,6 +120,9 @@ class _Conn:
         self.open = True
         self.busy = False          # a response is owed; pause parsing
         self.subs: list = []       # hub subscriptions (for close cleanup)
+        self.fwd: list = []        # upstream conns of dedicated watch
+        #                            proxies; severed on close to unblock
+        #                            their reader threads
 
 
 def _response(status: int, body: bytes,
@@ -332,8 +339,8 @@ class _HubStream:
         host, port = _upstream_addr(ing.cfg.upstream)
         t, path, rec = self.key
         q = f"wait=true&stream=true&recursive={'true' if rec else 'false'}"
+        conn = http.client.HTTPConnection(host, port, timeout=None)
         try:
-            conn = http.client.HTTPConnection(host, port, timeout=None)
             conn.request(
                 "GET", f"/tenants/{t}/v2/keys{path}?{q}")
             self.sock = conn.sock
@@ -353,6 +360,13 @@ class _HubStream:
             if not self.stopped:
                 log.warning("hub stream %s died: %s", self.key, e)
             self.hub.drop_stream(self, e)
+        finally:
+            # Only this thread may close the connection: other threads
+            # sever it via sock.shutdown (see _close_stream).
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
 
     def _deliver(self, ev: dict, raw: bytes) -> None:
         idx = int(ev.get("node", {}).get("modifiedIndex", 0) or 0)
@@ -399,12 +413,22 @@ class _Hub:
         return sum(len(st.subs) for st in self.streams.values())
 
     def subscribe(self, conn: _Conn, tenant: int, path: str,
-                  recursive: bool, stream: bool, since: int) -> None:
+                  recursive: bool, stream: bool, since: int) -> bool:
         """Attach a downstream watcher; serve from the replay ring when
-        its waitIndex is already covered (no upstream round trip)."""
+        its waitIndex is already covered (no upstream round trip).
+
+        Returns False when `since` predates the ring's coverage: the
+        ring only holds events seen since this hub stream opened, so
+        serving an older waitIndex from it would silently skip history
+        that direct etcd replays (or 401s EventIndexCleared on). The
+        caller must forward such watches upstream verbatim instead."""
         key = (tenant, path, recursive)
         with self.lock:
             st = self.streams.get(key)
+            if since and not (st is not None and st.ring
+                              and st.ring[0][0]
+                              and st.ring[0][0] <= since):
+                return False
             if st is None:
                 st = self.streams[key] = _HubStream(self, key)
                 st.thread.start()
@@ -434,6 +458,7 @@ class _Hub:
             st.subs.append(sub)
             conn.subs.append((st, sub))
             obs.ingress_hub_watchers.set(self.watcher_count())
+            return True
 
     def unsubscribe_conn(self, conn: _Conn) -> None:
         with self.lock:
@@ -453,7 +478,11 @@ class _Hub:
         obs.ingress_hub_streams.set(len(self.streams))
         try:
             if st.sock is not None:
-                st.sock.close()      # unblocks the reader's readline
+                # shutdown, not close: close() leaves a reader already
+                # blocked in recv blocked forever (and frees the fd for
+                # reuse under it); shutdown unblocks it with EOF and the
+                # reader thread closes its own connection on exit.
+                st.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
 
@@ -569,19 +598,27 @@ class Ingress:
         while not self._stop.is_set():
             for key, mask in self.sel.select(timeout=0.5):
                 tag = key.data
-                if tag == "accept":
-                    self._accept()
-                elif tag == "wake":
-                    try:
-                        self._wake_r.recv(65536)
-                    except OSError:
-                        pass
-                else:
-                    conn: _Conn = tag
-                    if mask & selectors.EVENT_READ:
-                        self._readable(conn)
-                    if conn.open and (mask & selectors.EVENT_WRITE):
-                        self._flush_wbuf(conn)
+                # One connection's failure (malformed input, handler
+                # bug) must never escape and freeze the loop — it owns
+                # every other connection on this ingress.
+                try:
+                    if tag == "accept":
+                        self._accept()
+                    elif tag == "wake":
+                        try:
+                            self._wake_r.recv(65536)
+                        except OSError:
+                            pass
+                    else:
+                        conn: _Conn = tag
+                        if mask & selectors.EVENT_READ:
+                            self._readable(conn)
+                        if conn.open and (mask & selectors.EVENT_WRITE):
+                            self._flush_wbuf(conn)
+                except Exception:  # noqa: BLE001 — close one conn, not all
+                    log.exception("ingress loop: connection handler failed")
+                    if isinstance(tag, _Conn):
+                        self._close(tag)
             self._drain_posted()
         # teardown
         for key in list(self.sel.get_map().values()):
@@ -602,15 +639,19 @@ class Ingress:
             conn, data, close_after = self._posted.popleft()
             if not conn.open:
                 continue
-            conn.busy = False
-            conn.wbuf += data
-            if close_after:
-                conn.closing = True
-                conn.streaming = False   # the stream just ended
-            self._flush_wbuf(conn)
-            # A pipelined request may already be buffered.
-            if conn.open and not conn.busy and not conn.streaming:
-                self._parse(conn)
+            try:
+                conn.busy = False
+                conn.wbuf += data
+                if close_after:
+                    conn.closing = True
+                    conn.streaming = False   # the stream just ended
+                self._flush_wbuf(conn)
+                # A pipelined request may already be buffered.
+                if conn.open and not conn.busy and not conn.streaming:
+                    self._parse(conn)
+            except Exception:  # noqa: BLE001 — close one conn, not all
+                log.exception("ingress loop: posted-send handling failed")
+                self._close(conn)
 
     def _accept(self) -> None:
         for _ in range(256):
@@ -640,6 +681,20 @@ class Ingress:
             pass
         if conn.subs:
             self.hub.unsubscribe_conn(conn)
+        for up in list(conn.fwd):
+            # Sever any dedicated watch proxy's upstream socket so its
+            # blocked readline unblocks and the thread exits. shutdown,
+            # NOT close: close() neither unblocks a reader already in
+            # recv nor is HTTPConnection.close() safe here — it grabs
+            # the response buffer's lock the blocked reader holds, which
+            # would deadlock this (the loop) thread. The proxy thread
+            # closes its own connection on the way out.
+            try:
+                if up.sock is not None:
+                    up.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        conn.fwd.clear()
 
     def _readable(self, conn: _Conn) -> None:
         try:
@@ -668,6 +723,12 @@ class Ingress:
         except OSError:
             self._close(conn)
             return
+        if len(conn.wbuf) > _MAX_WBUF:
+            # Backpressure: a stalled reader (slow watcher on a busy
+            # key) must not grow ingress memory without bound — drop it.
+            obs.ingress_slow_clients.inc()
+            self._close(conn)
+            return
         events = selectors.EVENT_READ
         if conn.wbuf:
             events |= selectors.EVENT_WRITE
@@ -688,10 +749,7 @@ class Ingress:
             end = conn.rbuf.find(b"\r\n\r\n")
             if end < 0:
                 if len(conn.rbuf) > _MAX_HEADER:
-                    conn.wbuf += _json_response(400, {
-                        "message": "headers too large"})
-                    conn.closing = True
-                    self._flush_wbuf(conn)
+                    self._bad_request(conn, "headers too large")
                 return
             head = bytes(conn.rbuf[:end]).decode("latin-1")
             lines = head.split("\r\n")
@@ -704,12 +762,13 @@ class Ingress:
             for ln in lines[1:]:
                 k, _, v = ln.partition(":")
                 headers[k.strip().lower()] = v.strip()
-            clen = int(headers.get("content-length", "0") or "0")
-            if clen > _MAX_BODY:
-                conn.wbuf += _json_response(400, {"message": "body too "
-                                                             "large"})
-                conn.closing = True
-                self._flush_wbuf(conn)
+            try:
+                clen = int(headers.get("content-length", "0") or "0")
+            except ValueError:
+                self._bad_request(conn, "malformed Content-Length")
+                return
+            if clen > _MAX_BODY or clen < 0:
+                self._bad_request(conn, "body too large")
                 return
             if len(conn.rbuf) < end + 4 + clen:
                 return
@@ -718,7 +777,23 @@ class Ingress:
             if headers.get("connection", "").lower() == "close":
                 conn.closing = True
             conn.busy = True
-            self._dispatch(conn, method, target, headers, body)
+            try:
+                self._dispatch(conn, method, target, headers, body)
+            except Exception as e:  # noqa: BLE001 — client-controlled input
+                # must never escape to the loop: 400 this connection only.
+                log.warning("ingress dispatch failed for %s %s: %s",
+                            method, target, e)
+                if conn.open:
+                    conn.busy = False
+                    self._bad_request(conn, f"bad request: {e}")
+                return
+
+    def _bad_request(self, conn: _Conn, msg: str) -> None:
+        """400 + close THIS connection; the loop keeps serving the rest."""
+        conn.rbuf.clear()       # never re-parse the poisoned bytes
+        conn.wbuf += _json_response(400, {"message": msg})
+        conn.closing = True
+        self._flush_wbuf(conn)
 
     def _reply(self, conn: _Conn, data: bytes) -> None:
         """Loop-thread synchronous reply to the CURRENT request."""
@@ -760,29 +835,56 @@ class Ingress:
                 key = rest[len("/v2/keys"):] or "/"
                 key = posixpath.normpath("/" + key.lstrip("/"))
                 if method in ("PUT", "POST", "DELETE"):
-                    self._handle_write(conn, tenant, method, key, p)
+                    self._handle_write(conn, tenant, method, key, p,
+                                       headers)
                     return
                 if method == "GET":
                     if p("wait") == "true":
-                        self.hub.subscribe(
-                            conn, tenant, key,
-                            p("recursive") == "true",
-                            p("stream") == "true",
-                            int(p("waitIndex") or 0))
-                        if p("stream") == "true":
+                        try:
+                            since = int(p("waitIndex") or 0)
+                        except ValueError:
+                            self._reply(conn, _json_response(400, {
+                                "errorCode": 203,
+                                "message": "The given index in POST "
+                                           "form is not a number"}))
+                            return
+                        recursive = p("recursive") == "true"
+                        stream = p("stream") == "true"
+                        if self.hub.subscribe(conn, tenant, key,
+                                              recursive, stream, since):
+                            if stream:
+                                conn.streaming = True
+                            return
+                        # waitIndex predates the hub ring's coverage:
+                        # forward upstream verbatim so history replay /
+                        # 401 EventIndexCleared keep direct semantics.
+                        if stream:
                             conn.streaming = True
+                        self._forward_watch(conn, tenant, key, recursive,
+                                            stream, since)
                         return
-                    self._forward(conn, tenant, method, target)
+                    self._forward(conn, tenant, method, target,
+                                  headers=headers)
                     return
         # Everything else (status, stats, engine surfaces) proxies
         # through unchanged — the ingress is transparent for them.
-        self._forward(conn, None, method, target, body=body)
+        self._forward(conn, None, method, target, body=body,
+                      headers=headers)
 
     def _handle_write(self, conn: _Conn, tenant: int, method: str,
-                      key: str, p) -> None:
+                      key: str, p, headers: Dict[str, str]) -> None:
         item = {"method": method, "path": key}
         if p("value"):
             item["value"] = p("value")
+        if p("recursive") == "true":
+            item["recursive"] = True
+        auth = headers.get("authorization")
+        if auth:
+            # Batches share ONE upstream connection for many clients:
+            # each slot carries its own client's credentials so the
+            # engine's per-tenant security evaluates the real identity,
+            # not the ingress's anonymous upstream socket.
+            item["auth"] = auth
         if p("ttl"):
             try:
                 item["ttl"] = int(p("ttl"))
@@ -838,20 +940,95 @@ class Ingress:
     # -- upstream GET / passthrough forwarding --------------------------------
 
     def _forward(self, conn: _Conn, tenant: Optional[int], method: str,
-                 target: str, body: bytes = b"") -> None:
-        """Proxy a non-coalescable request upstream on a fetcher thread.
-        Quorum GETs may be downgraded to local GETs under the lane's
-        read lease (renewed by every upstream batch ack — a committed
-        write proves the leader held quorum at ack time)."""
+                 target: str, body: bytes = b"",
+                 headers: Optional[Dict[str, str]] = None) -> None:
+        """Proxy a non-coalescable request upstream on a fetcher thread,
+        carrying the client's Authorization/Content-Type (identity must
+        survive the proxy hop or per-user ACLs break). Quorum GETs may
+        be downgraded to local GETs under the lane's read lease (renewed
+        by every upstream batch ack — a committed write proves the
+        leader held quorum at ack time)."""
         if (tenant is not None and "quorum=true" in target
                 and self.cfg.read_lease_ms > 0):
             lane = self.lane(tenant)
             if time.monotonic() < lane.lease_until:
                 target = target.replace("quorum=true", "quorum=false")
                 obs.ingress_lease_reads.inc()
+        fwd_headers = {}
+        for k in ("authorization", "content-type"):
+            v = (headers or {}).get(k)
+            if v:
+                fwd_headers[k.title()] = v
         with self._fetch_cv:
-            self._fetchq.append((conn, tenant, method, target, body))
+            self._fetchq.append((conn, tenant, method, target, body,
+                                 fwd_headers))
             self._fetch_cv.notify()
+
+    def _forward_watch(self, conn: _Conn, tenant: int, path: str,
+                       recursive: bool, stream: bool, since: int) -> None:
+        """A watch whose waitIndex the hub ring cannot cover gets its own
+        upstream connection on a dedicated thread (NOT the fetcher pool:
+        an unfired watch blocks until its event, and a handful of these
+        would starve every plain GET). Upstream then replays from event
+        history, answers 401 EventIndexCleared, or blocks — exactly the
+        direct-path semantics the ring cannot reproduce."""
+        q = (f"wait=true&waitIndex={since}"
+             f"&recursive={'true' if recursive else 'false'}")
+        if stream:
+            q += "&stream=true"
+        target = f"/tenants/{tenant}/v2/keys{path}?{q}"
+        threading.Thread(target=self._watch_proxy,
+                         args=(conn, target, stream), daemon=True,
+                         name="ingress-watch-fwd").start()
+
+    def _watch_proxy(self, conn: _Conn, target: str, stream: bool) -> None:
+        host, port = _upstream_addr(self.cfg.upstream)
+        up = http.client.HTTPConnection(host, port, timeout=None)
+        conn.fwd.append(up)      # _close severs this to unblock us
+        sent_headers = False
+        try:
+            up.request("GET", target)
+            resp = up.getresponse()
+            if not stream or resp.status != 200:
+                data = resp.read()
+                hdrs = {k: v for k, v in resp.getheaders()
+                        if k.lower().startswith("x-etcd")
+                        or k.lower().startswith("x-raft")}
+                ctype = resp.getheader("Content-Type", "application/json")
+                self.post_send(conn, _response(resp.status, data,
+                                               ctype=ctype, extra=hdrs),
+                               close_after=stream)
+                return
+            self.post_send(conn, (
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"))
+            sent_headers = True
+            while conn.open:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if line:
+                    self.post_send(conn, _chunk(line + b"\n"))
+            if conn.open:
+                self.post_send(conn, b"0\r\n\r\n", close_after=True)
+        except Exception as e:  # noqa: BLE001 — fail this conn only
+            if conn.open and sent_headers:
+                self.post_send(conn, b"0\r\n\r\n", close_after=True)
+            elif conn.open:
+                self.post_send(conn, _json_response(503, {
+                    "errorCode": 300, "message": "Raft Internal Error",
+                    "cause": f"ingress upstream watch failed: {e}"}))
+        finally:
+            try:
+                up.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            try:
+                conn.fwd.remove(up)
+            except ValueError:
+                pass
 
     def _fetcher(self) -> None:
         upstream: Optional[http.client.HTTPConnection] = None
@@ -862,7 +1039,7 @@ class Ingress:
                     self._fetch_cv.wait(0.5)
                 if self._stop.is_set():
                     return
-                conn, tenant, method, target, body = \
+                conn, tenant, method, target, body, fwd_headers = \
                     self._fetchq.popleft()
             if not conn.open:
                 continue
@@ -870,7 +1047,8 @@ class Ingress:
                 if upstream is None:
                     upstream = http.client.HTTPConnection(
                         host, port, timeout=self.cfg.request_timeout)
-                upstream.request(method, target, body=body or None)
+                upstream.request(method, target, body=body or None,
+                                 headers=fwd_headers)
                 resp = upstream.getresponse()
                 data = resp.read()
                 hdrs = {k: v for k, v in resp.getheaders()

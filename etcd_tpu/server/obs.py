@@ -192,6 +192,11 @@ ingress_lease_reads = metrics.Counter(
     "etcd_ingress_lease_reads_total",
     "Quorum GETs the ingress downgraded to plain local GETs under its "
     "read lease (a quorum-confirmed upstream ack within read_lease_ms).")
+ingress_slow_clients = metrics.Counter(
+    "etcd_ingress_slow_clients_total",
+    "Downstream connections dropped because their buffered response "
+    "backlog exceeded the per-connection cap (a stalled watcher on a "
+    "busy key must not grow ingress memory without bound).")
 
 
 # -- flight recorder ---------------------------------------------------------

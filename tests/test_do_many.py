@@ -285,3 +285,71 @@ def test_batch_http_route(tmp_path):
     finally:
         front.stop()
         eng.stop()
+
+
+def test_batch_per_slot_auth(tmp_path):
+    """Each batch slot is authorized under ITS OWN forwarded credentials
+    ("auth" field), not the carrying connection's: the ingress coalesces
+    many clients' writes onto one upstream socket, so without per-slot
+    identity every ACL would evaluate against one anonymous peer."""
+    import base64
+
+    from etcd_tpu.etcdhttp.tenants import EngineHttp
+
+    def post(url, payload, headers=None):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(), method="POST")
+        req.add_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null")
+
+    def put_json(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(), method="PUT")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read() or b"null")
+
+    eng = make_engine(tmp_path, round_interval=0.001)
+    front = EngineHttp(eng)
+    front.start()
+    eng.start()
+    base = front.url
+    try:
+        assert eng.wait_leaders(60.0)
+        st, _ = put_json(f"{base}/tenants/0/v2/security/users/root",
+                         {"user": "root", "password": "pw"})
+        assert st == 201
+        st, _ = put_json(f"{base}/tenants/0/v2/security/roles/guest",
+                         {"role": "guest", "permissions":
+                          {"kv": {"read": ["/*"], "write": []}}})
+        assert st == 201
+        st, _ = put_json(f"{base}/tenants/0/v2/security/enable", {})
+        assert st == 200
+
+        root = "Basic " + base64.b64encode(b"root:pw").decode()
+        # One batch, mixed identities, anonymous carrier connection:
+        # the authed slot commits, the anonymous slot 401s IN-SLOT.
+        st, body = post(f"{base}/tenants/0/batch", {"reqs": [
+            {"method": "PUT", "path": "/mix/anon", "value": "x"},
+            {"method": "PUT", "path": "/mix/root", "value": "ok",
+             "auth": root},
+        ]})
+        assert st == 200, body
+        rs = body["results"]
+        assert rs[0]["status"] == 401, rs
+        assert rs[0]["error"]["errorCode"] == 110, rs
+        assert rs[1]["status"] == 201, rs
+        # A malformed auth field fails the whole batch loudly (400).
+        st, body = post(f"{base}/tenants/0/batch", {"reqs": [
+            {"method": "PUT", "path": "/mix/bad", "value": "x",
+             "auth": 42}]})
+        assert st == 400, body
+    finally:
+        front.stop()
+        eng.stop()

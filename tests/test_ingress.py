@@ -70,12 +70,14 @@ class stack:
         self.eng.stop()
 
 
-def _put(base, t, key, val, timeout=30, **params):
+def _put(base, t, key, val, timeout=30, headers=None, **params):
     q = "&".join(f"{k}={v}" for k, v in params.items())
     req = urllib.request.Request(
         f"{base}/tenants/{t}/v2/keys{key}" + (f"?{q}" if q else ""),
         data=f"value={val}".encode(), method="PUT")
     req.add_header("Content-Type", "application/x-www-form-urlencoded")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read())
@@ -347,6 +349,247 @@ def test_watch_hub_differential_vs_direct(tmp_path):
                 _scrape(s.base, "etcd_ingress_hub_streams") != 0.0:
             time.sleep(0.1)
         assert _scrape(s.base, "etcd_ingress_hub_streams") == 0.0
+
+
+def _req_json(url, method="PUT", payload=None, headers=None, timeout=30):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def test_malformed_input_does_not_kill_loop(tmp_path):
+    """Client-controlled garbage — a non-numeric Content-Length, a
+    non-numeric waitIndex, a mangled request line — must cost that ONE
+    connection a 400/close, never the shared event loop (one loop thread
+    owns every connection on the ingress)."""
+    with stack(tmp_path) as s:
+        # Non-numeric Content-Length: 400 on this connection only.
+        sk = socket.create_connection(("127.0.0.1", s.ing.port),
+                                      timeout=10)
+        sk.sendall(b"PUT /tenants/0/v2/keys/x HTTP/1.1\r\n"
+                   b"Host: t\r\nContent-Length: banana\r\n\r\n")
+        sk.settimeout(10)
+        assert b" 400 " in sk.recv(4096)
+        sk.close()
+        # Non-numeric waitIndex: 400, not an unhandled ValueError.
+        try:
+            urllib.request.urlopen(
+                f"{s.base}/tenants/0/v2/keys/x?wait=true&waitIndex=abc",
+                timeout=10)
+            raise AssertionError("bad waitIndex was accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read())["errorCode"] == 203
+        # Mangled request line: connection dropped, loop unharmed.
+        sk2 = socket.create_connection(("127.0.0.1", s.ing.port),
+                                       timeout=10)
+        sk2.sendall(b"\x00\xff GARBAGE\r\n\r\n")
+        sk2.settimeout(10)
+        try:
+            sk2.recv(4096)
+        except OSError:
+            pass
+        sk2.close()
+        # The loop survived all three: normal service continues.
+        assert _put(s.base, 0, "/alive", "1")[0] == 201
+        assert _get_json(f"{s.base}/tenants/0/v2/keys/alive"
+                         )["node"]["value"] == "1"
+
+
+def test_recursive_delete_through_ingress(tmp_path):
+    """`DELETE ?recursive=true` must stay recursive through the
+    coalesced batch path — dropping the flag silently turns it into a
+    non-recursive delete (different result than the direct engine)."""
+    with stack(tmp_path) as s:
+        assert _put(s.base, 0, "/rd/a", "1")[0] == 201
+        assert _put(s.base, 0, "/rd/sub/b", "2")[0] == 201
+        req = urllib.request.Request(
+            f"{s.base}/tenants/0/v2/keys/rd?recursive=true",
+            method="DELETE")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+        assert body["action"] == "delete", body
+        try:
+            urllib.request.urlopen(f"{s.base}/tenants/0/v2/keys/rd/a",
+                                   timeout=10)
+            raise AssertionError("recursive delete left children behind")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # The flag genuinely travels (it is not a default): the same
+        # delete WITHOUT recursive refuses a non-empty dir, as direct.
+        assert _put(s.base, 0, "/rd2/a", "1")[0] == 201
+        req = urllib.request.Request(
+            f"{s.base}/tenants/0/v2/keys/rd2", method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("non-recursive delete of a dir passed")
+        except urllib.error.HTTPError as e:
+            assert e.code in (400, 403), e.code
+
+
+def test_watch_waitindex_history_ring_and_cleared(tmp_path):
+    """waitIndex semantics must match the direct path: an index older
+    than the hub ring's coverage replays from upstream event history
+    (never silently skipped), an index older than upstream history
+    answers 401 EventIndexCleared, and an index inside the ring is
+    served from the ring."""
+    import http.client
+    with stack(tmp_path) as s:
+        _, b1 = _put(s.base, 0, "/wi/a", "1")
+        i1 = b1["node"]["modifiedIndex"]
+        assert _put(s.base, 0, "/wi/b", "2")[0] == 201
+
+        # 1. Long-poll with a pre-hub waitIndex: the ring (empty — no
+        # hub stream exists) cannot cover it; upstream history replays.
+        ev = _get_json(f"{s.base}/tenants/0/v2/keys/wi"
+                       f"?wait=true&recursive=true&waitIndex={i1}")
+        assert ev["node"]["modifiedIndex"] == i1, ev
+
+        # 2. Stream watch with an old waitIndex through the dedicated
+        # proxy: the FIRST matching history event replays, then the
+        # stream goes live — exactly the direct path's (reference v2)
+        # stream-watch semantics, which scan history once per watch.
+        c = http.client.HTTPConnection("127.0.0.1", s.ing.port,
+                                       timeout=30)
+        c.request("GET", f"/tenants/0/v2/keys/wi?wait=true&stream=true"
+                         f"&recursive=true&waitIndex={i1}")
+        resp = c.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.readline())["node"]["modifiedIndex"] == i1
+        _, b3 = _put(s.base, 0, "/wi/c", "3")
+        assert (json.loads(resp.readline())["node"]["modifiedIndex"]
+                == b3["node"]["modifiedIndex"])
+        c.close()
+
+        # 3. Ring replay: a live hub stream's ring covers indexes it has
+        # seen; a long-poll inside that coverage is served immediately.
+        ch = http.client.HTTPConnection("127.0.0.1", s.ing.port,
+                                        timeout=30)
+        ch.request("GET", "/tenants/0/v2/keys/wi"
+                          "?wait=true&stream=true&recursive=true")
+        hub_resp = ch.getresponse()   # hub stream now live
+        time.sleep(0.3)
+        _, b4 = _put(s.base, 0, "/wi/d", "4")
+        i4 = b4["node"]["modifiedIndex"]
+        assert json.loads(hub_resp.readline()
+                          )["node"]["modifiedIndex"] == i4
+        ev = _get_json(f"{s.base}/tenants/0/v2/keys/wi"
+                       f"?wait=true&recursive=true&waitIndex={i4}",
+                       timeout=10)
+        assert ev["node"]["modifiedIndex"] == i4, ev
+        ch.close()
+
+        # 4. waitIndex beyond upstream event history: 401
+        # EventIndexCleared passes through — never a silent hang.
+        from etcd_tpu.store.event import DEFAULT_HISTORY_CAPACITY
+        roll = [Request(method="PUT",
+                        path=f"{STORE_KEYS_PREFIX}/roll/{i}",
+                        val=str(i))
+                for i in range(DEFAULT_HISTORY_CAPACITY + 64)]
+        for i in range(0, len(roll), 64):
+            s.eng.do_many(0, roll[i:i + 64])
+        try:
+            urllib.request.urlopen(
+                f"{s.base}/tenants/0/v2/keys/wi"
+                f"?wait=true&recursive=true&waitIndex={i1}", timeout=30)
+            raise AssertionError("cleared index did not error")
+        except urllib.error.HTTPError as e:
+            # Reference mapping: HTTP 400 carrying errorCode 401.
+            assert e.code == 400
+            assert json.loads(e.read())["errorCode"] == 401
+
+
+def test_auth_identity_survives_coalescing(tmp_path):
+    """With tenant security enabled, writes coalesced through the
+    ingress must be authorized as THEIR client, not as the ingress's
+    anonymous upstream connection: each batch slot carries its own
+    client's credentials."""
+    with stack(tmp_path, flush_max_requests=16) as s:
+        fb = s.front.url
+        auth = {"Authorization": "Basic " +
+                __import__("base64").b64encode(b"root:pw").decode()}
+        st, body = _req_json(fb + "/tenants/0/v2/security/users/root",
+                             payload={"user": "root", "password": "pw"})
+        assert st == 201, body
+        st, body = _req_json(
+            fb + "/tenants/0/v2/security/roles/guest",
+            payload={"role": "guest", "permissions":
+                     {"kv": {"read": ["/*"], "write": []}}})
+        assert st == 201, body
+        st, body = _req_json(fb + "/tenants/0/v2/security/enable")
+        assert st == 200, body
+
+        # Anonymous write through the ingress: denied in-slot.
+        st, body = _put(s.base, 0, "/sec/anon", "x")
+        assert st == 401 and body["errorCode"] == 110, (st, body)
+        # Authenticated write through the SAME coalescing lane: commits.
+        st, body = _put(s.base, 0, "/sec/root", "ok", headers=auth)
+        assert st == 201, (st, body)
+        # Interleaved in shared flush windows, each slot keeps its own
+        # identity: all root writes land, all anonymous writes 401.
+        outcomes = {}
+
+        def anon(i):
+            outcomes[("a", i)] = _put(s.base, 0, f"/sec/a{i}", "x")[0]
+
+        def rootw(i):
+            outcomes[("r", i)] = _put(s.base, 0, f"/sec/r{i}", "v",
+                                      headers=auth)[0]
+
+        ths = [threading.Thread(target=anon, args=(i,)) for i in range(6)]
+        ths += [threading.Thread(target=rootw, args=(i,))
+                for i in range(6)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=60)
+        assert all(not t.is_alive() for t in ths)
+        for i in range(6):
+            assert outcomes[("a", i)] == 401, outcomes
+            assert outcomes[("r", i)] == 201, outcomes
+        # Guest reads stay open; credentials also survive the GET
+        # passthrough (the fetcher forwards Authorization).
+        assert _get_json(f"{s.base}/tenants/0/v2/keys/sec/root"
+                         )["node"]["value"] == "ok"
+        st, body = _req_json(f"{s.base}/tenants/0/v2/security/users",
+                             method="GET")
+        assert st == 401, (st, body)
+        st, body = _req_json(f"{s.base}/tenants/0/v2/security/users",
+                             method="GET", headers=auth)
+        assert st == 200 and "root" in body.get("users", []), (st, body)
+
+
+def test_slow_client_wbuf_cap(tmp_path, monkeypatch):
+    """A stalled reader must not grow the ingress write buffer without
+    bound: past the cap the connection is dropped and counted."""
+    from etcd_tpu.server import ingress as ing_mod
+    from etcd_tpu.server import obs
+    ing = Ingress(IngressConfig(upstream="http://127.0.0.1:1"))
+    a, b = socket.socketpair()
+    try:
+        a.setblocking(False)
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+        conn = ing_mod._Conn(a)
+        monkeypatch.setattr(ing_mod, "_MAX_WBUF", 64 * 1024)
+        n0 = obs.ingress_slow_clients.value
+        conn.wbuf += b"x" * (1 << 20)   # 1 MB backlog, peer never reads
+        ing._flush_wbuf(conn)
+        assert not conn.open, "slow client kept its connection"
+        assert obs.ingress_slow_clients.value == n0 + 1
+    finally:
+        b.close()
+        ing._lsock.close()
+        ing._wake_r.close()
+        ing._wake_w.close()
+        ing.sel.close()
 
 
 @pytest.mark.slow
