@@ -1384,20 +1384,23 @@ def child_main() -> int:
                 "fsync": True}
 
     def measure_shallow_clients(sc_deadline):
-        """The ingress tier's reason to exist (round 10): CONNS
+        """The ingress tier under its reason-to-exist load: CONNS
         concurrent DEPTH-1 clients — each waits for its ack before its
-        next write, the worst shape for a batching engine — measured
-        A/B on the same box against the same engine subprocess (fsync
-        ON): direct-to-engine (thread-per-connection front, one do()
-        per request) vs through the coalescing ingress (epoll front,
-        per-tenant windows flushed as ONE /tenants/{t}/batch ->
-        do_many). Legs interleave direct/ingress/direct/ingress and the
-        LAST ingress leg SIGKILLs the ingress process mid-leg and
-        restarts it — every write acked to a client must still be
-        readable from the engine afterwards (values are per-client
-        monotone seqs, so stored seq >= last acked seq per key is
-        exact). Ends with the hub fan-out phase: W stream watchers of
-        ONE key through the ingress ride a single upstream stream."""
+        next write, the worst shape for a batching engine — measured on
+        the same box against the same engine subprocess (fsync ON).
+        Round 11 interleaves the A/B that matters now: the PIPELINED
+        binary-channel ingress (flush_window frames in flight, native
+        hot loop) vs a round-10-configured ingress (--upstream-mode
+        json: one JSON POST at a time), json/frame/json/frame, plus one
+        direct-to-engine leg for continuity with the round-10 ratio
+        (the direct path collapses under 10k depth-1 conns; a collapsed
+        leg records a NULL ratio, never a division artifact). The LAST
+        leg SIGKILLs the pipelined ingress mid-leg and restarts it —
+        every write acked to a client must still be readable from the
+        engine afterwards (values are per-client monotone seqs, so
+        stored seq >= last acked seq per key is exact). Ends with the
+        hub fan-out phase: W stream watchers of ONE key through the
+        ingress ride a single upstream stream."""
         import selectors as _selmod
         import socket as _sock
         import subprocess as _sp
@@ -1412,7 +1415,7 @@ def child_main() -> int:
         repo = os.path.dirname(os.path.abspath(__file__))
         env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
         env.pop("XLA_FLAGS", None)
-        eport, iport = _free_ports(2)
+        eport, iport, jport = _free_ports(3)
         ebase = f"http://127.0.0.1:{eport}"
         tmp = tempfile.mkdtemp(prefix="bench-shallow-")
         procs = []
@@ -1437,11 +1440,19 @@ def child_main() -> int:
                     time.sleep(0.3)
             raise RuntimeError("shallow_clients: engine never led")
 
-        def boot_ingress():
-            p = _sp.Popen(
-                [sys.executable, "-m", "etcd_tpu.server.ingress",
-                 "--upstream", ebase, "--port", str(iport)],
-                env=env, stdout=_sp.PIPE, stderr=_sp.DEVNULL)
+        def boot_ingress(port=iport, mode="frame"):
+            # The json arm is a FAITHFUL round-10 replica — JSON
+            # single-POST upstream AND the pure-Python hot loop (round
+            # 10 predates ingresscore.c) — so ingress_pipelined_vs_r10
+            # measures the whole round-11 delta, not just the
+            # transport. The frame arm runs the full round-11 config.
+            cmd = [sys.executable, "-m", "etcd_tpu.server.ingress",
+                   "--upstream", ebase, "--port", str(port),
+                   "--upstream-mode", mode]
+            if mode == "json":
+                cmd.append("--no-native")
+            p = _sp.Popen(cmd, env=env, stdout=_sp.PIPE,
+                          stderr=_sp.DEVNULL)
             p.stdout.readline()            # its ready line
             procs.append(p)
             return p
@@ -1558,8 +1569,8 @@ def child_main() -> int:
             cur.update(prefix=f"l{leg}", next=[0] * CONNS,
                        acked=[-1] * CONNS, dead_inflight={})
             conns = _connect(port, CONNS,
-                             "ingress" if kill_proc is not None
-                             or port == iport else "direct")
+                             {eport: "direct", iport: "frame-ingress",
+                              jport: "json-ingress"}.get(port, "?"))
             selx = _selmod.DefaultSelector()
             for c in conns:
                 selx.register(c.sock, _selmod.EVENT_READ, c)
@@ -1577,7 +1588,7 @@ def child_main() -> int:
                     kill_proc.kill()       # SIGKILL, mid-leg
                     kill_proc.wait()
                     killed = True
-                    boot_ingress()
+                    boot_ingress(port, "frame")
                     log("[shallow_clients] ingress SIGKILLed mid-leg "
                         "and restarted")
                 for key, mask in selx.select(0.2):
@@ -1656,7 +1667,8 @@ def child_main() -> int:
                     cur["acked"], cur["dead_inflight"])
 
         boot_engine()
-        boot_ingress()
+        frame_proc = boot_ingress(iport, "frame")
+        boot_ingress(jport, "json")    # the round-10 comparison side
         # Warm both paths (first quorum round + route caches) before
         # the clock starts.
         for t in range(T):
@@ -1694,7 +1706,10 @@ def child_main() -> int:
             log("[shallow_clients] drain barrier timed out "
                 f"after {max_s:.0f}s — next leg may share capacity")
 
-        # Four interleaved A/B legs plus a dedicated KILL leg. Each
+        # One direct leg (ratio continuity with round 10 — it collapses
+        # under 10k depth-1 conns), then the round-11 interleaved A/B:
+        # json/frame/json/frame (round-10-configured ingress vs the
+        # pipelined binary channel), plus a dedicated KILL leg. Each
         # leg's MEASURE window (post-connect) is an equal share of what
         # remains of the scenario budget, overridable via
         # BENCH_SHALLOW_LEG_S — the connect storms themselves (minutes
@@ -1706,18 +1721,24 @@ def child_main() -> int:
         # zero lost acked writes across the SIGKILL.
         span = max(20.0, (sc_deadline - time.time()) - 25.0)
         leg_s = float(os.environ.get("BENCH_SHALLOW_LEG_S", "0")) \
-            or max(15.0, span / 5.0)
-        d_acked = d_err = i_acked = i_err = 0
-        d_time = i_time = 0.0
-        d_lat, i_lat = [], []
+            or max(15.0, span / 6.0)
+        d_acked = d_err = j_acked = j_err = i_acked = i_err = 0
+        d_time = j_time = i_time = 0.0
+        d_lat, j_lat, i_lat = [], [], []
         ingress_audits = []        # (leg, acked_tbl, dead_inflight)
-        ingress_proc = procs[-1]
-        for leg, mode in enumerate(("direct", "ingress") * 2):
+        for leg, mode in enumerate(
+                ("direct", "json", "frame", "json", "frame")):
             if mode == "direct":
                 a, e, dt, _, _ = run_leg(leg, eport, leg_s, d_lat)
                 d_acked += a
                 d_err += e
                 d_time += dt
+            elif mode == "json":
+                a, e, dt, atbl, dinf = run_leg(leg, jport, leg_s, j_lat)
+                j_acked += a
+                j_err += e
+                j_time += dt
+                ingress_audits.append((leg, atbl, dinf))
             else:
                 a, e, dt, atbl, dinf = run_leg(leg, iport, leg_s, i_lat)
                 i_acked += a
@@ -1727,10 +1748,10 @@ def child_main() -> int:
             log(f"[shallow_clients] leg {leg} {mode}: {a} acked "
                 f"({e} errors) in {dt:.1f}s measured")
             _drain_engine(120.0)
-        kl = 4
+        kl = 5
         a, e, dt, atbl, dinf = run_leg(kl, iport, leg_s, [],
-                                       kill_proc=ingress_proc)
-        ingress_proc = procs[-1]
+                                       kill_proc=frame_proc)
+        frame_proc = procs[-1]
         ingress_audits.append((kl, atbl, dinf))
         log(f"[shallow_clients] kill leg: {a} acked ({e} errors) in "
             f"{dt:.1f}s measured (excluded from rates)")
@@ -1829,37 +1850,56 @@ def child_main() -> int:
                 p.kill()
 
         d_rate = d_acked / d_time if d_time else 0.0
+        j_rate = j_acked / j_time if j_time else 0.0
         i_rate = i_acked / i_time if i_time else 0.0
-        ratio = round(i_rate / d_rate, 2) if d_rate else None
+        # A collapsed direct leg (thread-per-conn front thrashing under
+        # 10k depth-1 conns: a handful of acks in minutes) makes the
+        # ratio a division artifact, not a measurement — record NULL
+        # and say so, never a six-figure "advantage".
+        collapsed = d_rate < 1.0
+        ratio = None if collapsed else round(i_rate / d_rate, 2)
+        r10_ratio = round(i_rate / j_rate, 2) if j_rate else None
         dp99 = (round(1000 * float(np.percentile(d_lat, 99)), 3)
                 if d_lat else None)
+        jp99 = (round(1000 * float(np.percentile(j_lat, 99)), 3)
+                if j_lat else None)
         ip50 = (round(1000 * float(np.percentile(i_lat, 50)), 3)
                 if i_lat else None)
         ip99 = (round(1000 * float(np.percentile(i_lat, 99)), 3)
                 if i_lat else None)
         hub_rate = hub_deliveries / hub_elapsed if hub_elapsed else 0.0
+        d_txt = ("direct: collapsed "
+                 f"({d_acked} acks in {d_time:.0f}s)" if collapsed
+                 else f"direct {d_rate:,.0f} acked/s -> {ratio}x")
         log(f"[shallow_clients] {CONNS} depth-1 conns, {T} tenants, "
-            f"fsync on: direct {d_rate:,.0f} acked/s vs ingress "
-            f"{i_rate:,.0f} acked/s -> {ratio}x (target >= 2x); ingress "
-            f"ack p50 {ip50} p99 {ip99} ms (direct p99 {dp99}); 0 lost "
-            f"acked writes across SIGKILL; hub {W_HUB} watchers x "
-            f"{hub_events} events -> {hub_deliveries} deliveries "
-            f"({hub_rate:,.0f}/s) over {hub_streams:.0f} upstream "
-            f"stream(s)")
+            f"fsync on: pipelined ingress {i_rate:,.0f} acked/s vs "
+            f"round-10 json ingress {j_rate:,.0f} acked/s -> "
+            f"{r10_ratio}x (target >= 5x); {d_txt}; pipelined ack p50 "
+            f"{ip50} p99 {ip99} ms (json p99 {jp99}, direct p99 "
+            f"{dp99}); {lost} lost acked writes across SIGKILL; hub "
+            f"{W_HUB} watchers x {hub_events} events -> "
+            f"{hub_deliveries} deliveries ({hub_rate:,.0f}/s) over "
+            f"{hub_streams:.0f} upstream stream(s)")
         return {"commits_per_sec": round(i_rate, 1),
                 "direct_acked_per_sec": round(d_rate, 1),
+                "direct_collapsed": collapsed,
                 "ingress_acked_per_sec": round(i_rate, 1),
+                "ingress_json_acked_per_sec": round(j_rate, 1),
                 "ingress_vs_direct": ratio,
+                "ingress_pipelined_vs_r10": r10_ratio,
                 "ingress_ack_p50_ms": ip50,
                 "ingress_ack_p99_ms": ip99,
+                "ingress_json_ack_p99_ms": jp99,
                 "direct_ack_p99_ms": dp99,
                 "p50_commit_latency_ms": ip50,
                 "p99_commit_latency_ms": ip99,
+                "flush_window": 4,
                 "hub_fanout": W_HUB,
                 "hub_deliveries": int(hub_deliveries),
                 "hub_deliveries_per_sec": round(hub_rate, 1),
                 "hub_upstream_streams": int(hub_streams),
                 "direct_errors": int(d_err),
+                "ingress_json_errors": int(j_err),
                 "ingress_errors": int(i_err),
                 "lost_acked_writes": int(lost),
                 "ingress_sigkilled": True,
@@ -2189,6 +2229,14 @@ def _regression_gate(line: str, artifact_dir=None) -> None:
                         # regression even if absolute acked/s held) and
                         # the client-observed ack tail a >25% rise.
                         ("ingress_vs_direct", False),
+                        # Round-11 column: the pipelined channel's
+                        # advantage over a round-10-configured (JSON
+                        # single-POST) ingress in the same interleaved
+                        # run gates a >20% fall; the ack tail
+                        # (ingress_ack_p99_ms above) keeps gating a
+                        # rise — pipelining must buy throughput without
+                        # giving the client-observed tail back.
+                        ("ingress_pipelined_vs_r10", False),
                         ("ingress_ack_p99_ms", True)):
             cmp(f"{sc}.{col}", v.get(col), o.get(col), ng, og,
                 lower_better=lb)

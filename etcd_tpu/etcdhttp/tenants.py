@@ -11,6 +11,14 @@ each tenant group gets the same client API one etcd cluster exposes):
                                upstream surface (server/ingress.py); one
                                HTTP request fans into one deep P_MULTI
                                log entry and N in-slot results
+    /tenants/{g}/batchframe    POST + Upgrade: etcd-batchframe -> 101,
+                               then the persistent binary flush channel
+                               (server/batchframe.py): length-prefixed
+                               request/response frames, pipelined up to
+                               the ingress flush window, submitted via
+                               MultiEngine.submit_many in frame order
+                               and collected off-thread so the staging
+                               queue never drains between flushes
     /tenants/{g}/status        group consensus status (leader, term,
                                commit, applied, active slots)
     /tenants/{g}/conf          POST {"op": "add"|"remove", "slot": n} —
@@ -22,6 +30,8 @@ each tenant group gets the same client API one etcd cluster exposes):
 from __future__ import annotations
 
 import json
+import queue
+import threading
 import time
 from typing import Dict
 
@@ -46,9 +56,11 @@ class _BatchSlotCtx:
 
     __slots__ = ("method", "headers")
 
-    def __init__(self, method: str, auth: str) -> None:
+    def __init__(self, method: str, auth) -> None:
         self.method = method
-        self.headers = {"Authorization": auth}
+        # No credentials -> empty headers: the slot is evaluated as the
+        # anonymous guest, never as the carrying ingress connection.
+        self.headers = {"Authorization": auth} if auth else {}
 
 
 class _TenantServer:
@@ -262,6 +274,8 @@ class TenantAPI:
             self._handle_conf(ctx, g)
         elif rest == "batch":
             self._handle_batch(ctx, g)
+        elif rest == "batchframe":
+            self._handle_batchframe(ctx, g)
         else:
             ctx.send_json(404, {"message": f"unknown tenant path {rest!r}"})
 
@@ -345,6 +359,151 @@ class TenantAPI:
         ctx.send_json(200, {"results": out},
                       {"X-Etcd-Index":
                        str(self.engine.store(g).current_index)})
+
+    def _handle_batchframe(self, ctx: Ctx, g: int) -> None:
+        """POST /tenants/{g}/batchframe + Upgrade: etcd-batchframe — the
+        ingress tier's persistent binary flush channel. After the 101
+        this connection's handler thread becomes the frame READER: it
+        parses each request frame (one walcodec-packed P_MULTI blob per
+        flush), runs per-slot auth, and stages the flush through
+        MultiEngine.submit_many WITHOUT waiting for commit — so a
+        pipelined ingress window keeps frames flowing while earlier
+        flushes are still in their fsync rounds. A per-channel COLLECTOR
+        thread gathers each flush's results in submission order and
+        writes one response frame per flush, each slot carrying the
+        final client-facing body so the ingress fan-back does no JSON
+        work. Frame-order submission preserves the lane's FIFO; the
+        fsync-gated ack invariant is untouched because collect_many only
+        yields results the ack path released."""
+        from etcd_tpu.server import batchframe
+        if (ctx.method != "POST"
+                or ctx.headers.get("Upgrade", "").lower()
+                != batchframe.UPGRADE_NAME):
+            ctx.send_json(426, {"message": "batchframe requires POST + "
+                                           "Upgrade: etcd-batchframe"},
+                          {"Upgrade": batchframe.UPGRADE_NAME})
+            return
+        rfile, wfile = ctx.hijack()
+        try:
+            wfile.write(batchframe.handshake_response())
+            wfile.flush()
+        except OSError:
+            return
+        jobs: queue.Queue = queue.Queue()
+        dead = threading.Event()
+        collector = threading.Thread(
+            target=self._batchframe_collector, args=(g, jobs, wfile, dead),
+            daemon=True, name=f"batchframe-collect{g}")
+        collector.start()
+        try:
+            while not dead.is_set():
+                frame = batchframe.read_request_frame(rfile)
+                if frame is None:
+                    break
+                jobs.put(self._batchframe_submit(g, *frame))
+        except OSError:
+            pass
+        finally:
+            jobs.put(None)
+            collector.join(timeout=30)
+
+    def _batchframe_submit(self, g: int, flush_id: int, auth_json: bytes,
+                           payload: bytes) -> tuple:
+        """Parse + auth-check + stage one request frame (reader thread,
+        non-blocking). Returns the collector's job: either a staged
+        flush or a frame-level error every rider of the flush gets."""
+        from etcd_tpu.server.engine import _unpack_multi
+        try:
+            if not payload:
+                raise ValueError("empty payload")
+            blobs = _unpack_multi(payload)
+            auths = (json.loads(auth_json.decode()) if auth_json
+                     else [None] * len(blobs))
+            if not isinstance(auths, list) or len(auths) != len(blobs):
+                raise ValueError("auth list does not match slot count")
+            reqs = [self._parse_batch_item(json.loads(b)) for b in blobs]
+        except errors.EtcdError as e:
+            return (flush_id, None, None, None,
+                    (e.status_code, e.to_json().encode() + b"\n"))
+        except Exception as e:  # noqa: BLE001 — channel input, fail the flush
+            body = json.dumps(
+                {"message": f"bad batchframe payload: {e}"}).encode()
+            return (flush_id, None, None, None, (400, body + b"\n"))
+        sec = self._sec(g)
+        results: list = [None] * len(reqs)
+        admitted, admitted_idx = [], []
+        for i, r in enumerate(reqs):
+            try:
+                sec.check_key_access(_BatchSlotCtx("POST", auths[i]), r)
+            except errors.EtcdError as e:
+                results[i] = e
+                continue
+            admitted.append(r)
+            admitted_idx.append(i)
+        queues = self.engine.submit_many(g, admitted) if admitted else []
+        return (flush_id, results, admitted_idx, queues, None)
+
+    def _batchframe_collector(self, g: int, jobs: queue.Queue, wfile,
+                              dead: threading.Event) -> None:
+        """Per-channel collector: block on each staged flush's results in
+        submission order and write its response frame. Responses demux by
+        flush id on the ingress side, so ordering here is a convenience,
+        not a contract."""
+        from etcd_tpu.etcdhttp.client import trim_prefix
+        from etcd_tpu.server import batchframe
+        from etcd_tpu.server.cluster import STORE_KEYS_PREFIX
+        broken = False
+        while True:
+            job = jobs.get()
+            if job is None:
+                return
+            flush_id, results, admitted_idx, queues, err = job
+            if broken:
+                # Channel already gone: the responses have nowhere to
+                # go (the ingress demux 503s the in-flight ids), but
+                # every staged flush must still be COLLECTED — its
+                # submit_many registered waiters and counted pending
+                # proposals, and only collect_many releases both. Skip
+                # it and the engine reports phantom pending proposals
+                # forever (the bench's inter-leg drain barrier hangs on
+                # exactly that gauge after the SIGKILL leg).
+                if queues:
+                    self.engine.collect_many(g, queues)
+                continue
+            if err is not None:
+                frame = batchframe.pack_error_frame(flush_id, err[0],
+                                                    err[1])
+            else:
+                if queues:
+                    for i, res in zip(admitted_idx,
+                                      self.engine.collect_many(g, queues)):
+                        results[i] = res
+                slots = []
+                for res in results:
+                    if isinstance(res, errors.EtcdError):
+                        if res.cause.startswith(STORE_KEYS_PREFIX):
+                            res.cause = res.cause[len(STORE_KEYS_PREFIX):]
+                        slots.append((res.status_code,
+                                      res.to_json().encode() + b"\n"))
+                    else:
+                        d = res.to_dict()
+                        created = (d.get("action") == "create"
+                                   or (d.get("action") == "set"
+                                       and d.get("prevNode") is None))
+                        slots.append((201 if created else 200,
+                                      json.dumps(trim_prefix(d)).encode()
+                                      + b"\n"))
+                frame = batchframe.pack_response_frame(flush_id, slots)
+            try:
+                wfile.write(frame)
+                wfile.flush()
+            except OSError:
+                # Channel gone: the reader unblocks on EOF/ sever; every
+                # un-responded flush 503s ingress-side (its demux fails
+                # exactly the in-flight ids — never a retry). Keep
+                # draining so later staged flushes get collected.
+                dead.set()
+                broken = True
 
     def _parse_batch_item(self, d: dict):
         """One batch item -> Request (the JSON twin of ClientAPI's

@@ -120,6 +120,18 @@ class Ctx:
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
 
+    # -- connection takeover (binary upgrade endpoints) -----------------------
+
+    def hijack(self):
+        """Take over the raw connection for a non-HTTP framed protocol
+        (the batchframe channel's 101 upgrade): returns (rfile, wfile)
+        positioned right after this request's body. The caller owns the
+        socket until it returns from its handler; the server then closes
+        the connection (keep-alive re-parse of binary frames as HTTP
+        would be garbage)."""
+        self._streaming = True      # handler loop closes the conn after
+        return self._h.rfile, self._h.wfile
+
     def client_gone(self) -> bool:
         """True once the peer closed its half of the connection — the
         CloseNotify analogue that lets long-polls release their watcher

@@ -1131,6 +1131,19 @@ class MultiEngine:
         only produced after the engine's ack path released the waiters,
         i.e. after this batch's round is fsync-durable — an ingress crash
         after `do_many` returns can never lose an acked write."""
+        return self.collect_many(g, self.submit_many(g, reqs), timeout)
+
+    def submit_many(self, g: int, reqs: List[Request]) -> List[tuple]:
+        """The NON-BLOCKING half of do_many: validate, assign request
+        ids, register wait queues and stage everything under one lock
+        acquisition — then return immediately with the (rid, queue)
+        tokens collect_many() blocks on. The batchframe channel
+        (etcdhttp/tenants.py) submits frame N+1 through this before
+        frame N's round has committed, which is what lets a pipelined
+        ingress window keep the staging queue deep instead of draining
+        it to zero between flushes. Submission order IS log-staging
+        order per group, so frames submitted in channel-arrival order
+        keep the lane's FIFO."""
         for r in reqs:
             if r.method not in (METHOD_PUT, METHOD_POST, METHOD_DELETE,
                                 METHOD_QGET, METHOD_SYNC):
@@ -1138,7 +1151,6 @@ class MultiEngine:
                                        cause=f"bad batch method {r.method}")
         obs_on = self.obs.enabled
         tr = self.obs.tracer
-        n = len(reqs)
         items = []
         queues = []
         for r in reqs:
@@ -1153,8 +1165,18 @@ class MultiEngine:
             if items:
                 self._dirty.add(g)
         if obs_on:
-            for _ in range(n):
+            for _ in range(len(items)):
                 metrics.propose_pending.inc()
+        return queues
+
+    def collect_many(self, g: int, queues: List[tuple],
+                     timeout: Optional[float] = None) -> List[Any]:
+        """The BLOCKING half of do_many: gather one result per submitted
+        (rid, queue) token, in submission order, timing out slots that
+        never produce one. Only returns results the ack path released —
+        i.e. after their round's fsync."""
+        obs_on = self.obs.enabled
+        n = len(queues)
         t0 = time.perf_counter()
         deadline = t0 + (timeout or self.cfg.request_timeout)
         out = []

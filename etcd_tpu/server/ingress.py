@@ -14,14 +14,31 @@ module is that role:
     same GIL the direct path does and manufacture nothing.
 
   * A per-tenant COALESCING LANE buffers writes inside an adaptive
-    window and ships each flush upstream as ONE POST /tenants/{t}/batch
-    (etcdhttp/tenants.py -> MultiEngine.do_many -> the existing P_MULTI
-    multi-request log-entry packing, so WAL format and replay are
-    untouched). The window never sleeps: it closes on request count
-    (flush_max_requests), on bytes (flush_max_bytes), or the moment an
-    upstream inflight slot frees while the buffer is non-empty (the
-    "drain" reason) — group commit's natural-batching policy at the
-    tier above the engine.
+    window and ships each flush upstream over a PERSISTENT BINARY
+    CHANNEL (server/batchframe.py: one 101-upgraded socket per lane,
+    length-prefixed frames, the slot payload packed by ONE
+    walcodec.pack_multi call) feeding MultiEngine.submit_many -> the
+    existing P_MULTI multi-request log-entry packing, so WAL format and
+    replay are untouched. The channel PIPELINES: up to
+    IngressConfig.flush_window flushes ride the wire at once, demuxed
+    by flush id — the engine's staging queue never drains to zero
+    between flushes, which is what lets the tier track the engine's
+    deep-queue capacity instead of its round-trip latency. The window
+    never sleeps: it closes on request count (flush_max_requests), on
+    bytes (flush_max_bytes), or the moment a pipeline slot frees while
+    the buffer is non-empty (the "drain" reason) — group commit's
+    natural-batching policy at the tier above the engine. Upstreams
+    that refuse the handshake (a router that only rewrites
+    /tenants/{t}/batch) fall back per lane to the round-10 JSON POST
+    path; channel re-establishment is paced by capped exponential
+    backoff.
+
+  * The PER-REQUEST HOT LOOP is native when built (ingresscore.c): one
+    GIL-releasing C pass scans a connection's read buffer into request
+    tuples, and each flush's fan-back materializes all N client
+    responses in one formatter call — the pure-Python reference path
+    remains the automatic fallback (etcd_ingress_native_enabled says
+    which is serving).
 
   * Acks/errors DEMULTIPLEX back to each waiting client only after the
     upstream ack: the ingress holds no durable state and never
@@ -65,7 +82,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from etcd_tpu.server import obs
+from etcd_tpu import native
+from etcd_tpu.server import batchframe, obs
 
 log = logging.getLogger("etcd_tpu.ingress")
 
@@ -90,7 +108,17 @@ class IngressConfig:
     # max_inflight=1 keeps per-client FIFO strict even for pipelined
     # writes (batches commit in flush order); depth-1 clients are
     # order-safe at any setting because they never overlap their own
-    # writes.
+    # writes. (JSON-path slot count; the binary channel's depth is
+    # flush_window.)
+    flush_window: int = 4              # pipelined flushes per lane on the
+    #                                    binary channel; per-client FIFO
+    #                                    holds at any depth because the
+    #                                    busy gate allows one outstanding
+    #                                    request per connection, and
+    #                                    frames submit to engine staging
+    #                                    in channel order.
+    upstream_mode: str = "auto"        # "auto" | "frame" | "json"
+    use_native: bool = True            # ingresscore.c hot loop when built
     read_lease_ms: int = 0
     request_timeout: float = 30.0
 
@@ -108,7 +136,8 @@ class _Conn:
     """One downstream client connection's loop-side state."""
 
     __slots__ = ("sock", "rbuf", "wbuf", "closing", "streaming",
-                 "want_write", "open", "busy", "subs", "fwd")
+                 "want_write", "open", "busy", "subs", "fwd",
+                 "pending", "perr")
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
@@ -123,6 +152,8 @@ class _Conn:
         self.fwd: list = []        # upstream conns of dedicated watch
         #                            proxies; severed on close to unblock
         #                            their reader threads
+        self.pending: deque = deque()  # scanned-but-undispatched requests
+        self.perr = 0              # scanner error latched behind pending
 
 
 def _response(status: int, body: bytes,
@@ -153,6 +184,12 @@ def _chunk(data: bytes) -> bytes:
     return f"{len(data):x}\r\n".encode() + data + b"\r\n"
 
 
+def _err_body(cause: str) -> bytes:
+    """Client-facing body of a whole-flush upstream failure."""
+    return json.dumps({"errorCode": 300, "message": "Raft Internal Error",
+                       "cause": cause}).encode() + b"\n"
+
+
 # ---------------------------------------------------------------------------
 # the coalescing lane (one per tenant)
 # ---------------------------------------------------------------------------
@@ -167,15 +204,133 @@ class _PendingWrite:
         self.t0 = time.perf_counter()
 
 
+class _Channel:
+    """One lane's persistent binary upstream channel (batchframe).
+
+    Flushes PIPELINE: send_flush registers the batch under a fresh flush
+    id and writes one request frame without waiting; the reader thread
+    demultiplexes response frames back to their batches in any order.
+    A send/read failure SEVERS the channel: every registered (in-flight)
+    flush fans back a 503 and nothing is ever re-sent — a flush the
+    upstream may have read MAY have committed, and re-sending it would
+    double-apply POSTs and break CAS chains. The clients that never got
+    an ack own the retry, exactly as with a direct engine."""
+
+    __slots__ = ("lane", "sock", "rfile", "lock", "inflight", "next_id",
+                 "alive", "born", "reader")
+
+    def __init__(self, lane: "_Lane", sock: socket.socket, rfile) -> None:
+        self.lane = lane
+        self.sock = sock
+        self.rfile = rfile
+        self.lock = threading.Lock()
+        self.inflight: Dict[int, List[_PendingWrite]] = {}
+        self.next_id = 1
+        self.alive = True
+        self.born = time.monotonic()
+        self.reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"ingress-chan{lane.tenant}")
+        self.reader.start()
+
+    def window_used(self) -> int:
+        with self.lock:
+            return len(self.inflight)
+
+    def send_flush(self, batch: List[_PendingWrite], auth_json: bytes,
+                   payload: bytes) -> bool:
+        """Register + send one flush. False = channel already dead and
+        the CALLER still owns the batch. True = the channel owns it: the
+        reader acks it or sever() 503s it."""
+        err: Optional[Exception] = None
+        with self.lock:
+            if not self.alive:
+                return False
+            fid = self.next_id
+            self.next_id += 1
+            self.inflight[fid] = batch
+            try:
+                # Send under the lock: concurrent flushers' frame bytes
+                # must never interleave on the wire.
+                self.sock.sendall(batchframe.pack_request_frame(
+                    fid, auth_json, payload))
+            except OSError as e:
+                err = e
+        if err is not None:
+            self.sever(err)
+        else:
+            obs.ingress_upstream_frames.labels("sent").inc()
+        return True
+
+    def _read_loop(self) -> None:
+        lane = self.lane
+        try:
+            while True:
+                frame = batchframe.read_response_frame(self.rfile)
+                if frame is None:
+                    raise OSError("upstream closed batchframe channel")
+                fid, slots, error = frame
+                obs.ingress_upstream_frames.labels("recv").inc()
+                with self.lock:
+                    batch = self.inflight.pop(fid, None)
+                if batch is None:
+                    continue       # already failed over in sever()
+                if slots is None:
+                    status, body = error
+                    lane.fan_error(batch, status, bytes(body))
+                elif len(slots) != len(batch):
+                    lane.fan_error(batch, 503, _err_body(
+                        "upstream batchframe slot count mismatch"))
+                else:
+                    lane.fan_acks(batch, slots)
+                lane.window_notify()
+        except Exception as e:  # noqa: BLE001 — sever fans back per client
+            self.sever(e)
+        finally:
+            # Only this (the reader) thread closes the fds: other
+            # threads sever via shutdown so a blocked read unblocks with
+            # EOF instead of racing a close-and-reuse under it.
+            try:
+                self.rfile.close()
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def sever(self, err: Exception) -> None:
+        """Mark the channel dead and 503 EXACTLY the in-flight flushes
+        (never a retry). Idempotent; callable from any thread."""
+        with self.lock:
+            was_alive, self.alive = self.alive, False
+            pending, self.inflight = self.inflight, {}
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        if pending:
+            obs.ingress_upstream_severed.inc(len(pending))
+            body = _err_body(f"ingress upstream channel severed: {err}")
+            for batch in pending.values():
+                self.lane.fan_error(batch, 503, body)
+        if was_alive:
+            self.lane.channel_down(self)
+
+
 class _Lane:
     """Per-tenant coalescing window + its flusher thread(s).
 
     The flusher never sleeps on a timer: it waits on the condition until
-    the buffer is non-empty AND either a threshold tripped or an
-    upstream inflight slot is free, takes up to the caps, and does the
-    upstream POST synchronously. While that batch is in flight new
-    writes pile into the buffer; the moment the flusher returns it takes
-    them all — upstream latency IS the adaptive window."""
+    the buffer is non-empty AND a pipeline slot is free (flush_window on
+    the binary channel, max_inflight on the JSON fallback), takes up to
+    the caps, and ships the batch. On the channel the ship is
+    FIRE-AND-FORGET — the flusher loops straight back to building the
+    next window while up to flush_window flushes ride the wire, so
+    upstream round-trip latency stops being the lane's clock; acks
+    demultiplex on the channel's reader thread. On the JSON path the
+    POST is synchronous and upstream latency IS the adaptive window,
+    exactly the round-10 behavior."""
 
     def __init__(self, ing: "Ingress", tenant: int) -> None:
         self.ing = ing
@@ -187,6 +342,15 @@ class _Lane:
         self.stopped = False
         self.lease_until = 0.0       # monotonic; quorum-read lease
         cfg = ing.cfg
+        self.mode = cfg.upstream_mode     # "auto" | "frame" | "json";
+        #                                   auto flips to json per lane
+        #                                   when the upstream 4xxes the
+        #                                   batchframe handshake
+        self.chan: Optional[_Channel] = None
+        self._connect_lock = threading.Lock()
+        self._backoff = 0.0          # capped exponential reconnect pace
+        self._next_connect = 0.0     # monotonic gate for the next dial
+        self._had_channel = False
         self.threads = [
             threading.Thread(target=self._flusher, daemon=True,
                              name=f"ingress-lane{tenant}-{i}")
@@ -203,6 +367,28 @@ class _Lane:
     def stop(self) -> None:
         with self.cv:
             self.stopped = True
+            self.cv.notify_all()
+            chan = self.chan
+        if chan is not None:
+            chan.sever(RuntimeError("ingress stopping"))
+
+    def window_notify(self) -> None:
+        """A pipeline slot freed (channel reader finished a flush)."""
+        with self.cv:
+            self.cv.notify_all()
+
+    def channel_down(self, chan: "_Channel") -> None:
+        """The channel severed: pace the re-dial. A channel that lived a
+        while earns a fresh (minimal) backoff; a flapping one doubles it
+        up to the cap."""
+        with self.cv:
+            if self.chan is chan:
+                self.chan = None
+            now = time.monotonic()
+            if now - chan.born > 2.0:
+                self._backoff = 0.0
+            self._backoff = min(2.0, self._backoff * 2 or 0.05)
+            self._next_connect = now + self._backoff
             self.cv.notify_all()
 
     def _take(self) -> Tuple[List[_PendingWrite], str]:
@@ -223,18 +409,31 @@ class _Lane:
         self.bytes -= nbytes
         return batch, reason
 
+    def _ready(self) -> bool:
+        """cv predicate: non-empty buffer AND a free upstream slot.
+        On the channel a slot is a flush_window pipeline slot (hard cap:
+        a tripped threshold waits for a slot rather than overrunning the
+        window); on the JSON path thresholds may overrun max_inflight
+        exactly as in round 10."""
+        if not self.buf:
+            return False
+        cfg = self.ing.cfg
+        if self.mode != "json":
+            chan = self.chan
+            if chan is None or not chan.alive:
+                return True      # dial (or backoff-503) proceeds
+            return chan.window_used() < cfg.flush_window
+        if self.inflight < cfg.max_inflight:
+            return True
+        return (len(self.buf) >= cfg.flush_max_requests
+                or self.bytes >= cfg.flush_max_bytes)
+
     def _flusher(self) -> None:
         upstream: Optional[http.client.HTTPConnection] = None
         host, port = _upstream_addr(self.ing.cfg.upstream)
         while True:
             with self.cv:
-                while not self.stopped and (
-                        not self.buf
-                        or (self.inflight >= self.ing.cfg.max_inflight
-                            and len(self.buf)
-                            < self.ing.cfg.flush_max_requests
-                            and self.bytes
-                            < self.ing.cfg.flush_max_bytes)):
+                while not self.stopped and not self._ready():
                     self.cv.wait(0.5)
                 if self.stopped:
                     return
@@ -243,28 +442,156 @@ class _Lane:
             obs.ingress_inflight.inc()
             obs.ingress_flush_reason.labels(reason).inc()
             obs.ingress_batch.observe(len(batch))
+            # Exactly ONE fan_acks/fan_error happens per batch (that is
+            # where ingress_inflight decrements): immediately below on
+            # the failure paths, on the channel's reader thread for a
+            # pipelined flush, inline for a JSON POST.
             try:
-                upstream = self._flush(upstream, host, port, batch)
+                if self.mode != "json":
+                    chan = self._ensure_channel(host, port)
+                    if self.mode == "json":
+                        # auto-fallback flipped during this dial
+                        upstream = self._flush_json(upstream, host, port,
+                                                    batch)
+                    elif chan is None:
+                        self.fan_error(batch, 503, _err_body(
+                            "ingress upstream channel unavailable: "
+                            "reconnect backoff"))
+                    elif not chan.send_flush(
+                            batch, *self._encode_frame(batch)):
+                        self.fan_error(batch, 503, _err_body(
+                            "ingress upstream channel severed"))
+                else:
+                    upstream = self._flush_json(upstream, host, port,
+                                                batch)
             finally:
-                obs.ingress_inflight.dec()
                 with self.cv:
                     self.inflight -= 1
                     self.cv.notify_all()
 
-    def _flush(self, upstream, host, port,
-               batch: List[_PendingWrite]):
-        """One window -> ONE upstream request -> per-client fan-back.
-        Returns the (possibly re-opened) upstream connection. Never
-        raises: an upstream failure becomes a per-client 503 — no
-        retry here, because a batch that died after the upstream read
-        its request MAY have committed, and re-sending it would
-        double-apply POSTs and break CAS chains. The client that never
-        got an ack owns the retry, exactly as with a direct engine."""
+    def _ensure_channel(self, host: str,
+                        port: int) -> Optional[_Channel]:
+        """Return the live channel, (re)dialing under capped exponential
+        backoff; None while backing off or unreachable. In auto mode a
+        non-101 handshake (an upstream that routes /batch but not
+        /batchframe) flips this lane to the JSON path permanently."""
+        with self._connect_lock:
+            chan = self.chan
+            if chan is not None and chan.alive:
+                return chan
+            now = time.monotonic()
+            if now < self._next_connect:
+                return None
+            if self._had_channel or self._backoff:
+                obs.ingress_upstream_reconnects.inc()
+            sock = rfile = None
+            try:
+                sock = socket.create_connection(
+                    (host, port), timeout=self.ing.cfg.request_timeout)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.sendall(batchframe.handshake_request(
+                    self.tenant, f"{host}:{port}"))
+                rfile = sock.makefile("rb")
+                status = batchframe.read_handshake_status(rfile)
+            except OSError as e:
+                for f in (rfile, sock):
+                    try:
+                        if f is not None:
+                            f.close()
+                    except OSError:
+                        pass
+                self._backoff = min(2.0, self._backoff * 2 or 0.05)
+                self._next_connect = now + self._backoff
+                log.warning("lane %d: batchframe dial failed (%s); "
+                            "next try in %.2fs", self.tenant, e,
+                            self._backoff)
+                return None
+            if status != 101:
+                for f in (rfile, sock):
+                    try:
+                        f.close()
+                    except OSError:
+                        pass
+                if self.mode == "auto":
+                    self.mode = "json"
+                    obs.ingress_upstream_fallbacks.inc()
+                    log.info("lane %d: upstream has no batchframe "
+                             "endpoint (handshake status %d); using the "
+                             "JSON batch path", self.tenant, status)
+                    return None
+                self._backoff = min(2.0, self._backoff * 2 or 0.05)
+                self._next_connect = now + self._backoff
+                return None
+            sock.settimeout(None)    # the reader blocks on acks forever
+            self._had_channel = True
+            self.chan = _Channel(self, sock, rfile)
+            return self.chan
+
+    def _encode_frame(self, batch: List[_PendingWrite]
+                      ) -> Tuple[bytes, bytes]:
+        """(auth_json, payload) of one request frame. Items ride as the
+        same JSON dicts the /batch route takes (TTLs must resolve
+        against the ENGINE clock; rids are assigned engine-side); the
+        whole flush packs in ONE pack_multi call."""
+        auth_json = b""
+        if any("auth" in pw.item for pw in batch):
+            auth_json = json.dumps(
+                [pw.item.get("auth") for pw in batch]).encode()
+        payload = native.pack_multi(
+            [(0, b"\x00" + json.dumps(pw.item).encode())
+             for pw in batch], batchframe.P_MULTI)
+        return auth_json, payload
+
+    def fan_acks(self, batch: List[_PendingWrite],
+                 slots: List[Tuple[int, bytes]]) -> None:
+        """Upstream acked (durable: results release after the engine
+        round's fsync) — only NOW may any client see its ack. One
+        formatter call materializes the whole flush's responses."""
+        lease_s = self.ing.cfg.read_lease_ms / 1000.0
+        if lease_s > 0:
+            self.lease_until = time.monotonic() + lease_s
+        now = time.perf_counter()
+        outs = self.ing.fmt_responses(
+            [(status, bytes(body)) for status, body in slots])
+        sends = []
+        for pw, (status, _body), out in zip(batch, slots, outs):
+            obs.ingress_ack_ms.observe((now - pw.t0) * 1000.0)
+            if status >= 400:
+                obs.ingress_errors.inc()
+            else:
+                obs.ingress_acked.inc()
+            sends.append((pw.conn, out))
+        self.ing.post_send_many(sends)
+        obs.ingress_inflight.dec()
+
+    def fan_error(self, batch: List[_PendingWrite], status: int,
+                  body: bytes) -> None:
+        """Whole-flush failure: one formatted response, every rider."""
+        out = self.ing.fmt_responses([(status, body)])[0]
+        obs.ingress_errors.inc(len(batch))
+        self.ing.post_send_many([(pw.conn, out) for pw in batch])
+        obs.ingress_inflight.dec()
+
+    def _flush_json(self, upstream, host, port,
+                    batch: List[_PendingWrite]):
+        """Round-10 fallback: one window -> ONE JSON POST
+        /tenants/{t}/batch -> per-client fan-back. Returns the (possibly
+        re-opened) upstream connection. Never raises and never retries:
+        a batch that died after the upstream read its request MAY have
+        committed, and re-sending it would double-apply POSTs and break
+        CAS chains. The client that never got an ack owns the retry,
+        exactly as with a direct engine."""
+        if upstream is None and time.monotonic() < self._next_connect:
+            self.fan_error(batch, 503, _err_body(
+                "ingress upstream unavailable: reconnect backoff"))
+            return None
         body = json.dumps(
             {"reqs": [pw.item for pw in batch]}).encode()
         path = f"/tenants/{self.tenant}/batch"
         try:
             if upstream is None:
+                if self._backoff:
+                    obs.ingress_upstream_reconnects.inc()
                 upstream = http.client.HTTPConnection(
                     host, port, timeout=self.ing.cfg.request_timeout)
             upstream.request("POST", path, body=body,
@@ -282,28 +609,21 @@ class _Lane:
                     upstream.close()
             except OSError:
                 pass
-            obs.ingress_errors.inc(len(batch))
-            err = _json_response(503, {
-                "errorCode": 300, "message": "Raft Internal Error",
-                "cause": f"ingress upstream flush failed: {e}"})
-            for pw in batch:
-                self.ing.post_send(pw.conn, err)
+            self._backoff = min(2.0, self._backoff * 2 or 0.05)
+            self._next_connect = time.monotonic() + self._backoff
+            self.fan_error(batch, 503, _err_body(
+                f"ingress upstream flush failed: {e}"))
             return None
-        # The upstream ack is durable (do_many results release after the
-        # round's fsync) — only NOW may any client see its ack.
-        now = time.perf_counter()
-        lease_s = self.ing.cfg.read_lease_ms / 1000.0
-        if lease_s > 0:
-            self.lease_until = time.monotonic() + lease_s
-        for pw, res in zip(batch, results):
-            obs.ingress_ack_ms.observe((now - pw.t0) * 1000.0)
+        self._backoff = 0.0
+        slots = []
+        for res in results:
             if "error" in res:
-                obs.ingress_errors.inc()
-                out = _json_response(res.get("status", 500), res["error"])
+                slots.append((res.get("status", 500),
+                              json.dumps(res["error"]).encode() + b"\n"))
             else:
-                obs.ingress_acked.inc()
-                out = _json_response(res.get("status", 200), res["event"])
-            self.ing.post_send(pw.conn, out)
+                slots.append((res.get("status", 200),
+                              json.dumps(res["event"]).encode() + b"\n"))
+        self.fan_acks(batch, slots)
         return upstream
 
 
@@ -527,6 +847,12 @@ class Ingress:
 
     def __init__(self, cfg: IngressConfig) -> None:
         self.cfg = cfg
+        self.use_native = cfg.use_native and native.HAVE_NATIVE_INGRESS
+        self._scan = (native.scan_requests if self.use_native
+                      else native._py_scan_requests)
+        self._fmt = (native.format_responses if self.use_native
+                     else native._py_format_responses)
+        obs.ingress_native_enabled.set(1.0 if self.use_native else 0.0)
         self.lanes: Dict[int, _Lane] = {}
         self._lanes_lock = threading.Lock()
         self.hub = _Hub(self)
@@ -591,6 +917,21 @@ class Ingress:
             self._wake_w.send(b"x")
         except OSError:
             pass
+
+    def post_send_many(self, sends: List[Tuple[_Conn, bytes]]) -> None:
+        """post_send for a whole flush's fan-back: one wake byte, not N."""
+        self._posted.extend((conn, data, False) for conn, data in sends)
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    def fmt_responses(self, slots: List[Tuple[int, bytes]]) -> List[bytes]:
+        """Materialize final HTTP responses for (status, body) slots —
+        one ingresscore call per flush when the extension is built."""
+        if self.use_native:
+            obs.ingress_native_formatted.inc(len(slots))
+        return self._fmt(slots)
 
     # -- the loop ------------------------------------------------------------
 
@@ -745,37 +1086,37 @@ class Ingress:
     # -- HTTP parse + dispatch ----------------------------------------------
 
     def _parse(self, conn: _Conn) -> None:
-        while conn.open and not conn.busy:
-            end = conn.rbuf.find(b"\r\n\r\n")
-            if end < 0:
-                if len(conn.rbuf) > _MAX_HEADER:
-                    self._bad_request(conn, "headers too large")
-                return
-            head = bytes(conn.rbuf[:end]).decode("latin-1")
-            lines = head.split("\r\n")
-            try:
-                method, target, _ver = lines[0].split(" ", 2)
-            except ValueError:
-                self._close(conn)
-                return
-            headers = {}
-            for ln in lines[1:]:
-                k, _, v = ln.partition(":")
-                headers[k.strip().lower()] = v.strip()
-            try:
-                clen = int(headers.get("content-length", "0") or "0")
-            except ValueError:
-                self._bad_request(conn, "malformed Content-Length")
-                return
-            if clen > _MAX_BODY or clen < 0:
-                self._bad_request(conn, "body too large")
-                return
-            if len(conn.rbuf) < end + 4 + clen:
-                return
-            body = bytes(conn.rbuf[end + 4:end + 4 + clen])
-            del conn.rbuf[:end + 4 + clen]
-            if headers.get("connection", "").lower() == "close":
+        """Drain complete pipelined requests off the read buffer — ONE
+        scanner pass (ingresscore.c when built) emits every complete
+        request at once; dispatch then pops them as the busy gate
+        allows (≤1 outstanding request per connection)."""
+        while conn.open and not conn.busy and not conn.streaming:
+            if not conn.pending:
+                if conn.perr:
+                    self._scan_error(conn)
+                    return
+                if not conn.rbuf:
+                    return
+                reqs, consumed, err = self._scan(conn.rbuf)
+                if consumed:
+                    del conn.rbuf[:consumed]
+                if reqs and self.use_native:
+                    obs.ingress_native_scanned.inc(len(reqs))
+                conn.pending.extend(reqs)
+                conn.perr = err
+                if not conn.pending:
+                    if err:
+                        self._scan_error(conn)
+                    return
+            (method, target, ctype, auth, close,
+             body) = conn.pending.popleft()
+            if close:
                 conn.closing = True
+            headers: Dict[str, str] = {}
+            if ctype is not None:
+                headers["content-type"] = ctype
+            if auth is not None:
+                headers["authorization"] = auth
             conn.busy = True
             try:
                 self._dispatch(conn, method, target, headers, body)
@@ -788,9 +1129,24 @@ class Ingress:
                     self._bad_request(conn, f"bad request: {e}")
                 return
 
+    def _scan_error(self, conn: _Conn) -> None:
+        """A scanner error surfaced behind the already-emitted requests:
+        act on it only once those have dispatched (here)."""
+        err, conn.perr = conn.perr, 0
+        if err == native.ING_EBADLINE:
+            self._close(conn)
+            return
+        self._bad_request(conn, {
+            native.ING_EBADLEN: "malformed Content-Length",
+            native.ING_EBODY: "body too large",
+            native.ING_EHEADERS: "headers too large",
+        }.get(err, "bad request"))
+
     def _bad_request(self, conn: _Conn, msg: str) -> None:
         """400 + close THIS connection; the loop keeps serving the rest."""
         conn.rbuf.clear()       # never re-parse the poisoned bytes
+        conn.pending.clear()
+        conn.perr = 0
         conn.wbuf += _json_response(400, {"message": msg})
         conn.closing = True
         self._flush_wbuf(conn)
@@ -1094,6 +1450,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--flush-max-requests", type=int, default=1024)
     ap.add_argument("--flush-max-bytes", type=int, default=1 << 20)
     ap.add_argument("--max-inflight", type=int, default=1)
+    ap.add_argument("--flush-window", type=int, default=4,
+                    help="pipelined flushes per lane on the binary "
+                         "upstream channel")
+    ap.add_argument("--upstream-mode", default="auto",
+                    choices=("auto", "frame", "json"),
+                    help="binary batchframe channel, JSON POSTs, or "
+                         "auto-detect per lane")
+    ap.add_argument("--no-native", action="store_true",
+                    help="force the pure-Python request scan / response "
+                         "format hot loop")
     ap.add_argument("--read-lease-ms", type=int, default=0)
     args = ap.parse_args(argv)
     ing = Ingress(IngressConfig(
@@ -1101,6 +1467,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         flush_max_requests=args.flush_max_requests,
         flush_max_bytes=args.flush_max_bytes,
         max_inflight=args.max_inflight,
+        flush_window=args.flush_window,
+        upstream_mode=args.upstream_mode,
+        use_native=(not args.no_native
+                    and os.environ.get("ETCD_INGRESS_NO_NATIVE") != "1"),
         read_lease_ms=args.read_lease_ms))
     ing.start()
     print(json.dumps({"port": ing.port, "pid": os.getpid(),

@@ -198,6 +198,48 @@ ingress_slow_clients = metrics.Counter(
     "backlog exceeded the per-connection cap (a stalled watcher on a "
     "busy key must not grow ingress memory without bound).")
 
+# The pipelined binary upstream channel (server/batchframe.py): one
+# persistent frame connection per lane, up to flush_window flushes in
+# flight, demuxed by flush id. These families meter the channel's
+# lifecycle (reconnects with capped backoff, JSON-path fallbacks when
+# the upstream doesn't speak frames) and its frame traffic.
+ingress_upstream_reconnects = metrics.Counter(
+    "etcd_ingress_upstream_reconnects_total",
+    "Upstream channel (re-)establishment attempts after a failure or a "
+    "severed channel; paced by capped exponential backoff so a flapping "
+    "engine never spins a lane flusher hot.")
+ingress_upstream_fallbacks = metrics.Counter(
+    "etcd_ingress_upstream_fallbacks_total",
+    "Lanes that fell back from the binary batchframe channel to the "
+    "JSON /batch path because the upstream refused the 101 handshake "
+    "(e.g. a router that only rewrites /tenants/{t}/batch).")
+ingress_upstream_frames = metrics.LabeledCounter(
+    "etcd_ingress_upstream_frames_total",
+    "Binary frames on the upstream channel by direction (sent = request "
+    "frames / one per flush; recv = response frames).", ("direction",))
+ingress_upstream_severed = metrics.Counter(
+    "etcd_ingress_upstream_severed_flushes_total",
+    "In-flight flushes failed back with 503 because their channel died "
+    "before their response frame arrived (exactly the registered "
+    "flush ids — never a retry, a dead flush MAY have committed).")
+
+# The native (ingresscore.c) hot loop. The *_total counters meter the
+# scan/format hot loop regardless of codec; etcd_ingress_native_enabled
+# says which implementation is serving (1 = C extension, 0 = the pure-
+# Python reference fallback).
+ingress_native_enabled = metrics.Gauge(
+    "etcd_ingress_native_enabled",
+    "1 when the ingresscore C extension serves the HTTP scan/format hot "
+    "loop, 0 when the pure-Python fallback does.")
+ingress_native_scanned = metrics.Counter(
+    "etcd_ingress_native_scanned_requests_total",
+    "Client HTTP requests emitted by the read-buffer scanner (one "
+    "GIL-releasing C pass per readable event when native is enabled).")
+ingress_native_formatted = metrics.Counter(
+    "etcd_ingress_native_formatted_responses_total",
+    "Client HTTP responses materialized by the batch response formatter "
+    "(whole-flush fan-backs format in one call when native is enabled).")
+
 
 # -- flight recorder ---------------------------------------------------------
 

@@ -286,6 +286,24 @@ def render(prev, cur, dt):
                           dt)
         L.append(f"  hub watchers {hw or 0:6.0f}   upstream streams "
                  f"{hs or 0:4.0f}   deliveries/s {hd:8.1f}")
+        # Round-11 pipelined channel + native hot loop: frame flow on
+        # the persistent upstream, its failure counters, and which
+        # codec the hot loop is running.
+        fsent = counter_rate(prev, cur,
+                             "etcd_ingress_upstream_frames_total", dt,
+                             (("direction", "sent"),))
+        frecv = counter_rate(prev, cur,
+                             "etcd_ingress_upstream_frames_total", dt,
+                             (("direction", "recv"),))
+        recon = gauge(cur, "etcd_ingress_upstream_reconnects_total")
+        sever = gauge(cur, "etcd_ingress_upstream_severed_flushes_total")
+        fall = gauge(cur, "etcd_ingress_upstream_fallbacks_total")
+        nat = gauge(cur, "etcd_ingress_native_enabled")
+        L.append(f"  upstream frames/s sent {fsent:7.1f} recv "
+                 f"{frecv:7.1f}   reconnects {recon or 0:4.0f}   "
+                 f"severed {sever or 0:5.0f}   fallbacks "
+                 f"{fall or 0:3.0f}   native "
+                 f"{'-' if nat is None else ('on' if nat else 'off')}")
     return L
 
 

@@ -2,8 +2,8 @@
 """ThreadSanitizer check of the C extensions (the closest this Python
 runtime gets to the reference's `go test --race`, reference test:46-48).
 
-Builds storecore.c and walcodec.c with -fsanitize=thread into a temp
-dir, then exercises them from concurrent threads in a child process
+Builds storecore.c, walcodec.c and ingresscore.c with -fsanitize=thread
+into a temp dir, then exercises them from concurrent threads in a child process
 running under LD_PRELOAD=libtsan: 4 writer threads + a reader against
 one Core, plus the applier-pool shapes — K shard cores each driven by
 its own thread through set_many(need=...) (the per-shard apply +
@@ -34,7 +34,7 @@ CHILD = r"""
 import sys, threading
 sys.path.insert(0, sys.argv[1])
 sys.path.insert(1, sys.argv[2])
-import storecore, walcodec
+import ingresscore, storecore, walcodec
 from etcd_tpu.utils.metrics import Histogram, Registry
 from etcd_tpu.server.obs import FlightRecorder, SUBMITTED, ACKED
 
@@ -307,6 +307,92 @@ def ingress_hub_sub(sid):
             break
     assert got == list(range(ING_EVENTS)), (sid, len(got))
 
+# Pipelined-channel shapes (round 11, server/ingress.py _Channel):
+# the flusher drains the lane window and SENDS while earlier flushes
+# are still un-acked — up to PIPE_WINDOW flush ids in flight, tracked
+# in an inflight map under the channel lock — and a demux thread
+# delivers acks OUT OF ORDER by flush id (the reader thread's
+# inflight.pop(fid) demux). Each send packs through pack_multi and
+# each ack formats the fan-back through ingresscore.format_responses
+# (both C under real thread interleaving). The contract asserted raw:
+# every flush id acked exactly once, per-submitter acks stay FIFO even
+# when the wire acks arrive scrambled.
+PIPE_SUBMITTERS, PIPE_WRITES, PIPE_WINDOW = 3, 200, 4
+pipe_cv = threading.Condition()
+pipe_buf = []
+pipe_lock = threading.Lock()          # the channel lock
+pipe_inflight = {}                    # fid -> batch
+pipe_wire = []                        # "socket": frames awaiting demux
+pipe_wire_cv = threading.Condition()
+pipe_acks = [0] * PIPE_SUBMITTERS
+pipe_ack_cv = threading.Condition()
+pipe_done = {"sent": 0, "acked": 0}
+
+def pipe_submitter(tid):
+    for i in range(PIPE_WRITES):
+        with pipe_cv:
+            pipe_buf.append((tid, i, b"\x00" + b"q" * (8 + i % 7)))
+            pipe_cv.notify()
+        with pipe_ack_cv:
+            while pipe_acks[tid] < i + 1:
+                pipe_ack_cv.wait(10)
+
+def pipe_flusher():
+    fid = 0
+    total = PIPE_SUBMITTERS * PIPE_WRITES
+    while pipe_done["sent"] < total:
+        with pipe_cv:
+            while not pipe_buf:
+                pipe_cv.wait(10)
+            batch, pipe_buf[:] = pipe_buf[:8], pipe_buf[8:]
+        # Window gate: at most PIPE_WINDOW flushes in flight.
+        with pipe_wire_cv:
+            while len(pipe_inflight) >= PIPE_WINDOW:
+                pipe_wire_cv.wait(10)
+        blob = walcodec.pack_multi([(1, pl) for _, _, pl in batch], 2)
+        fid += 1
+        with pipe_lock:
+            pipe_inflight[fid] = batch
+        with pipe_wire_cv:
+            pipe_wire.append((fid, blob))
+            pipe_done["sent"] += len(batch)
+            pipe_wire_cv.notify_all()
+
+def pipe_demux():
+    total = PIPE_SUBMITTERS * PIPE_WRITES
+    while pipe_done["acked"] < total:
+        with pipe_wire_cv:
+            while not pipe_wire:
+                pipe_wire_cv.wait(10)
+            frames, pipe_wire[:] = list(pipe_wire), []
+        # Scramble ack order within the drained window — the demux must
+        # not depend on wire FIFO.
+        for fid, blob in reversed(frames):
+            with pipe_lock:
+                batch = pipe_inflight.pop(fid)
+            outs = ingresscore.format_responses(
+                [(200, b'{"ok":%d}' % i) for _, i, _ in batch])
+            assert len(outs) == len(batch)
+            with pipe_ack_cv:
+                for tid, i, _ in batch:
+                    assert pipe_acks[tid] == i, (tid, i, pipe_acks[tid])
+                    pipe_acks[tid] = i + 1
+                pipe_done["acked"] += len(batch)
+                pipe_ack_cv.notify_all()
+        with pipe_wire_cv:
+            pipe_wire_cv.notify_all()   # window freed
+
+# Native hot-loop shapes: concurrent GIL-releasing request scans over
+# per-thread buffers racing the formatter (two C passes that share no
+# state — TSan proves it stays that way).
+SCAN_REQ = (b"PUT /v2/keys/a HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 7\r\n\r\nvalue=1") * 40
+
+def native_scanner(tid):
+    for _ in range(400):
+        reqs, consumed, err = ingresscore.scan_requests(SCAN_REQ)
+        assert err == 0 and len(reqs) == 40 and consumed == len(SCAN_REQ)
+
 ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
       + [threading.Thread(target=reader), threading.Thread(target=codec)]
       + [threading.Thread(target=shard_applier, args=(shards[k], k))
@@ -331,7 +417,13 @@ ts = ([threading.Thread(target=writer, args=(t,)) for t in range(4)]
       + [threading.Thread(target=ingress_flusher),
          threading.Thread(target=ingress_hub_reader)]
       + [threading.Thread(target=ingress_hub_sub, args=(s,))
-         for s in range(ING_SUBS)])
+         for s in range(ING_SUBS)]
+      + [threading.Thread(target=pipe_submitter, args=(t,))
+         for t in range(PIPE_SUBMITTERS)]
+      + [threading.Thread(target=pipe_flusher),
+         threading.Thread(target=pipe_demux)]
+      + [threading.Thread(target=native_scanner, args=(t,))
+         for t in range(2)])
 for t in ts:
     t.start()
 for t in ts:
@@ -342,6 +434,8 @@ if thread_errors:
 assert min(wal_durable) == WAL_TICKETS, wal_durable
 assert min(ing_acks) == ING_WRITES, ing_acks
 assert ing_hist.count > 0 and not ing_buf
+assert min(pipe_acks) == PIPE_WRITES, pipe_acks
+assert not pipe_inflight and not pipe_wire
 assert read_state["applied"] == READ_BATCHES, read_state
 assert read_core.index == READ_BATCHES * RB_N, read_core.index
 # Lock-light loss bound: single counts may drop under the race, but
@@ -390,7 +484,7 @@ def main() -> int:
     inc = sysconfig.get_paths()["include"]
     ext = sysconfig.get_config_var("EXT_SUFFIX")
     with tempfile.TemporaryDirectory(prefix="tsan-") as tmp:
-        for src in ("storecore", "walcodec"):
+        for src in ("storecore", "walcodec", "ingresscore"):
             r = subprocess.run(
                 ["cc", "-O1", "-g", "-fsanitize=thread", "-Wall",
                  "-shared", "-fPIC", f"-I{inc}",
@@ -413,15 +507,18 @@ def main() -> int:
                   f"{warnings} TSan warnings)")
             print(out[-4000:])
             return 1
-    print("tsan_check: OK — storecore + walcodec clean under "
-          "ThreadSanitizer (4 writers + reader + codec threads, 4 shard "
-          "appliers via set_many(need=...), 2 same-core set_many "
-          "contenders + reader, 3 WAL-writer streams + submitter + "
-          "watermark waiter, read-plane confirmer + applier vs 3 parked "
-          "readers, 4 histogram observers vs scraper + flight ring "
-          "submitter vs trace reader, ingress coalescer: 4 depth-1 "
-          "submitters vs lane flusher packing via pack_multi + hub "
-          "reader vs 3 subscriber drains)")
+    print("tsan_check: OK — storecore + walcodec + ingresscore clean "
+          "under ThreadSanitizer (4 writers + reader + codec threads, "
+          "4 shard appliers via set_many(need=...), 2 same-core "
+          "set_many contenders + reader, 3 WAL-writer streams + "
+          "submitter + watermark waiter, read-plane confirmer + "
+          "applier vs 3 parked readers, 4 histogram observers vs "
+          "scraper + flight ring submitter vs trace reader, ingress "
+          "coalescer: 4 depth-1 submitters vs lane flusher packing via "
+          "pack_multi + hub reader vs 3 subscriber drains, pipelined "
+          "channel: 3 submitters vs windowed flusher vs out-of-order "
+          "ack demux through format_responses, 2 GIL-releasing "
+          "scan_requests threads)")
     return 0
 
 

@@ -351,6 +351,63 @@ def test_gate_ingress_columns_absent_in_old_artifact_silent(
     assert not out.out.strip()
 
 
+# -- round-11 pipelined-ingress columns --------------------------------------
+
+_SHALLOW11 = dict(_SHALLOW, ingress_pipelined_vs_r10=6.0)
+
+
+def test_gate_flags_pipelined_ratio_fall(tmp_path, capsys):
+    """The pipelined channel's advantage over the round-10 JSON ingress
+    (measured in the SAME interleaved run) gates a >20% fall — the
+    binary/pipelined win eroding back toward single-POST cost is a
+    regression even if absolute acked/s held. The ack tail staying flat
+    must NOT flag alongside it."""
+    bench = _load_bench()
+    prev = _mk_artifact9(tmp_path, {"shallow_clients": _SHALLOW11})
+    cur = {"shallow_clients": dict(_SHALLOW11,
+                                   ingress_pipelined_vs_r10=4.0)}
+    bench._regression_gate(_cur_line9(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" in out.err
+    emitted = json.loads(out.out.strip().splitlines()[-1])
+    flagged = {f["scenario"] for f in emitted["perf_regressions"]}
+    assert flagged == {"shallow_clients.ingress_pipelined_vs_r10"}
+    fall = emitted["perf_regressions"][0]
+    assert fall["now"] == 4.0 and fall["drop_pct"] > 20
+
+
+def test_gate_collapsed_direct_ratio_silent(tmp_path, capsys):
+    """When the direct leg collapses under the conn load the round-11
+    bench records ingress_vs_direct as null rather than a degenerate
+    ~0-denominator blowup — the gate must treat the null as 'no data',
+    not as a fall from the prior artifact's real ratio."""
+    bench = _load_bench()
+    prev = _mk_artifact9(tmp_path, {"shallow_clients": _SHALLOW11})
+    cur = {"shallow_clients": dict(_SHALLOW11, ingress_vs_direct=None,
+                                   direct_collapsed=True)}
+    bench._regression_gate(_cur_line9(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert not out.out.strip()
+
+
+def test_gate_pipelined_column_absent_in_r10_artifact_silent(
+        tmp_path, capsys):
+    """A round-10 artifact carries shallow_clients but no
+    ingress_pipelined_vs_r10 column — the new gate leg must stay silent
+    while the round-10 columns keep gating."""
+    bench = _load_bench()
+    prev = _mk_artifact9(tmp_path, {"shallow_clients": _SHALLOW})
+    cur = {"shallow_clients": _SHALLOW11}
+    bench._regression_gate(_cur_line9(prev, cur),
+                           artifact_dir=str(tmp_path))
+    out = capsys.readouterr()
+    assert "PERF REGRESSION" not in out.err
+    assert not out.out.strip()
+
+
 def test_gate_read_columns_absent_in_old_artifact_silent(tmp_path, capsys):
     """Artifacts that predate the read plane carry none of the round-9
     scenarios or columns — the gate must stay silent, not misfire."""

@@ -353,3 +353,224 @@ def test_batch_per_slot_auth(tmp_path):
     finally:
         front.stop()
         eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# the binary upstream channel (POST /tenants/{t}/batchframe + upgrade)
+# ---------------------------------------------------------------------------
+
+def test_p_multi_tag_pin():
+    """batchframe.P_MULTI is a mirror (the ingress process must not
+    import the engine): pin it to the engine's authoritative value."""
+    from etcd_tpu.server import batchframe, engine
+    assert batchframe.P_MULTI == engine.P_MULTI
+
+
+def _item(r):
+    """Request -> the item-dict JSON of the /batch(frame) slot schema."""
+    d = {"method": r.method, "path": r.path}
+    if r.val is not None:
+        d["value"] = r.val
+    if r.prev_value is not None:
+        d["prevValue"] = r.prev_value
+    if r.prev_exist is not None:
+        d["prevExist"] = r.prev_exist
+    if r.prev_index:
+        d["prevIndex"] = r.prev_index
+    return d
+
+
+def _open_channel(port, tenant):
+    import socket
+
+    from etcd_tpu.server import batchframe
+    sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+    sock.sendall(batchframe.handshake_request(tenant, "t"))
+    rfile = sock.makefile("rb")
+    assert batchframe.read_handshake_status(rfile) == 101
+    return sock, rfile
+
+
+def test_batchframe_route_and_wal_parity(tmp_path):
+    """The binary channel is observably the JSON /batch route: the same
+    per-group workload shipped as PIPELINED request frames (both frames
+    on the wire before the first response is read) returns the same
+    slot statuses, and after a restart the store state is identical to
+    a JSON-batch twin — both transports feed the same P_MULTI entries,
+    so WAL replay cannot tell them apart."""
+    from etcd_tpu import native
+    from etcd_tpu.etcdhttp.tenants import EngineHttp
+    from etcd_tpu.server import batchframe
+
+    d_frame, d_batch = tmp_path / "frame", tmp_path / "batch"
+
+    eng = make_engine(d_frame, round_interval=0.001)
+    front = EngineHttp(eng)
+    front.start()
+    eng.start()
+    frame_status = {}
+    try:
+        assert eng.wait_leaders(60.0)
+        for g in range(G):
+            w = _workload(g)
+            sock, rfile = _open_channel(front.http.port, g)
+            try:
+                for fid, part in ((7, w[:5]), (8, w[5:])):
+                    payload = native.pack_multi(
+                        [(0, b"\x00" + json.dumps(_item(r)).encode())
+                         for r in part], batchframe.P_MULTI)
+                    sock.sendall(batchframe.pack_request_frame(
+                        fid, b"", payload))
+                sts = []
+                for fid in (7, 8):
+                    rid, slots, err = batchframe.read_response_frame(rfile)
+                    assert rid == fid and err == (), (rid, err)
+                    sts += [s for s, _ in slots]
+                frame_status[g] = sts
+                # Slot bodies are final client-facing JSON.
+                assert json.loads(slots[-1][1])["node"]["key"] == "/k2"
+            finally:
+                sock.close()
+        # Mixed outcomes land in their slots: CAS fail 412, rest applied.
+        for g in range(G):
+            assert frame_status[g] == [201, 201, 200, 201, 201,
+                                       200, 412, 201], frame_status[g]
+    finally:
+        front.stop()
+        eng.stop()
+
+    eng = make_engine(d_batch, round_interval=0.001)
+    front = EngineHttp(eng)
+    front.start()
+    eng.start()
+    try:
+        assert eng.wait_leaders(60.0)
+        for g in range(G):
+            w = _workload(g)
+            for part in (w[:5], w[5:]):
+                req = urllib.request.Request(
+                    f"{front.url}/tenants/{g}/batch",
+                    data=json.dumps(
+                        {"reqs": [_item(r) for r in part]}).encode(),
+                    method="POST")
+                req.add_header("Content-Type", "application/json")
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    assert r.status == 200
+    finally:
+        front.stop()
+        eng.stop()
+
+    s1, s2 = _state_after_restart(d_frame), _state_after_restart(d_batch)
+    for g in range(G):
+        assert s1[g]["index"] == s2[g]["index"], g
+        assert s1[g]["dump"] == s2[g]["dump"], g
+        assert s1[g]["history"] == s2[g]["history"], g
+        assert s1[g]["watch"] == s2[g]["watch"], g
+
+
+def test_batchframe_error_frame_and_handshake_refusals(tmp_path):
+    """Channel input failures answer as FRAME-LEVEL errors (the flush
+    fails loudly, the channel survives), and the handshake refuses
+    non-upgrade requests with 426."""
+    import urllib.request
+
+    from etcd_tpu import native
+    from etcd_tpu.etcdhttp.tenants import EngineHttp
+    from etcd_tpu.server import batchframe
+
+    eng = make_engine(tmp_path, round_interval=0.001)
+    front = EngineHttp(eng)
+    front.start()
+    eng.start()
+    try:
+        assert eng.wait_leaders(60.0)
+        sock, rfile = _open_channel(front.http.port, 0)
+        try:
+            # Garbage payload -> error frame with FRAME_ERROR marker.
+            sock.sendall(batchframe.pack_request_frame(3, b"", b"junk"))
+            fid, slots, err = batchframe.read_response_frame(rfile)
+            assert fid == 3 and slots is None and err[0] == 400, (fid, err)
+            # The channel still works after the bad frame.
+            payload = native.pack_multi(
+                [(0, b"\x00" + json.dumps(
+                    {"method": "PUT", "path": "/alive", "value": "1"}
+                  ).encode())], batchframe.P_MULTI)
+            sock.sendall(batchframe.pack_request_frame(4, b"", payload))
+            fid, slots, err = batchframe.read_response_frame(rfile)
+            assert fid == 4 and err == () and slots[0][0] == 201
+        finally:
+            sock.close()
+        # No Upgrade header -> 426, connection stays HTTP.
+        req = urllib.request.Request(
+            f"{front.url}/tenants/0/batchframe", data=b"", method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=15)
+            assert False, "expected 426"
+        except urllib.error.HTTPError as e:
+            assert e.code == 426
+    finally:
+        front.stop()
+        eng.stop()
+
+def test_batchframe_sever_midflight_collects_staged_flushes(tmp_path):
+    """A channel severed with flushes still staged (the ingress
+    SIGKILL) must not leak them: the engine-side collector keeps
+    draining its queue and COLLECTS every staged flush even though the
+    responses have nowhere to go — otherwise each abandoned slot pins
+    etcd_server_pending_proposal_total forever (the bench's inter-leg
+    drain barrier hangs on exactly that gauge after the kill leg)."""
+    import socket
+    import struct
+    import time
+
+    from etcd_tpu import native
+    from etcd_tpu.etcdhttp.tenants import EngineHttp
+    from etcd_tpu.server import batchframe
+    from etcd_tpu.utils import metrics
+
+    eng = make_engine(tmp_path, round_interval=0.001)
+    front = EngineHttp(eng)
+    front.start()
+    eng.start()
+    try:
+        assert eng.wait_leaders(60.0)
+        base = metrics.propose_pending.value
+        sock, rfile = _open_channel(front.http.port, 0)
+        for fid in range(1, 4):
+            payload = native.pack_multi(
+                [(0, b"\x00" + json.dumps(
+                    {"method": "PUT", "path": f"/sv/{fid}_{i}",
+                     "value": "x"}).encode()) for i in range(3)],
+                batchframe.P_MULTI)
+            sock.sendall(batchframe.pack_request_frame(fid, b"", payload))
+        # RST the channel without reading a single response — the
+        # collector's frame writes fail mid-queue.
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+        rfile.close()
+        sock.close()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if metrics.propose_pending.value <= base:
+                break
+            time.sleep(0.1)
+        assert metrics.propose_pending.value <= base, \
+            metrics.propose_pending.value
+        # The endpoint survives the sever: a fresh channel works. (How
+        # many of the severed flushes committed is NOT asserted — the
+        # RST may have cut frames the engine had not read yet; the
+        # invariant is that whatever WAS staged got collected.)
+        sock2, rfile2 = _open_channel(front.http.port, 0)
+        try:
+            payload = native.pack_multi(
+                [(0, b"\x00" + json.dumps(
+                    {"method": "PUT", "path": "/sv/after",
+                     "value": "y"}).encode())], batchframe.P_MULTI)
+            sock2.sendall(batchframe.pack_request_frame(9, b"", payload))
+            fid, slots, err = batchframe.read_response_frame(rfile2)
+            assert fid == 9 and err == () and slots[0][0] == 201
+        finally:
+            sock2.close()
+    finally:
+        front.stop()
+        eng.stop()

@@ -658,3 +658,348 @@ def test_many_connections_fd_smoke(tmp_path):
             proc.wait(timeout=30)
         front.stop()
         eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# binary upstream channel: pipelining, ack demux, sever semantics
+# ---------------------------------------------------------------------------
+
+class _FakeFrameUpstream:
+    """A scriptable stand-in for the engine's upstream surface: each
+    accepted connection's first request head is handed to `script`
+    (along with the raw socket + buffered reader) on its own thread, so
+    tests can ack out of order, sever mid-window, or refuse the
+    batchframe handshake."""
+
+    def __init__(self, script):
+        self.script = script
+        self.frames = []       # (conn_idx, flush_id, [item dict, ...])
+        self.accepted = 0
+        self.lsock = socket.socket()
+        self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.lsock.bind(("127.0.0.1", 0))
+        self.lsock.listen(16)
+        self.port = self.lsock.getsockname()[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                sock, _ = self.lsock.accept()
+            except OSError:
+                return
+            idx, self.accepted = self.accepted, self.accepted + 1
+            threading.Thread(target=self._serve, args=(idx, sock),
+                             daemon=True).start()
+
+    def _serve(self, idx, sock):
+        rfile = sock.makefile("rb")
+        try:
+            head = self._read_head(rfile)
+            if head is not None:
+                self.script(self, idx, sock, rfile, head)
+        except OSError:
+            pass
+        finally:
+            for f in (rfile, sock):
+                try:
+                    f.close()
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _read_head(rfile):
+        lines = []
+        while True:
+            line = rfile.readline(8192)
+            if not line:
+                return None if not lines else lines
+            if line in (b"\r\n", b"\n"):
+                return lines
+            lines.append(line.rstrip(b"\r\n"))
+
+    def read_frame(self, idx, rfile):
+        from etcd_tpu.server import batchframe
+        from etcd_tpu.server.engine import _unpack_multi
+        frame = batchframe.read_request_frame(rfile)
+        if frame is None:
+            return None
+        fid, _auth, payload = frame
+        items = [json.loads(b) for b in _unpack_multi(payload)]
+        self.frames.append((idx, fid, items))
+        return fid, items
+
+    @staticmethod
+    def ack(sock, fid, slots):
+        from etcd_tpu.server import batchframe
+        sock.sendall(batchframe.pack_response_frame(fid, slots))
+
+    def close(self):
+        try:
+            self.lsock.close()
+        except OSError:
+            pass
+
+
+def _raw_put(port, t, key, val, timeout=30):
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    body = f"value={val}".encode()
+    s.sendall((f"PUT /tenants/{t}/v2/keys{key} HTTP/1.1\r\nHost: t\r\n"
+               "Content-Type: application/x-www-form-urlencoded\r\n"
+               f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+    return s
+
+
+def _read_http_response(s, timeout=30):
+    s.settimeout(timeout)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        d = s.recv(4096)
+        if not d:
+            raise OSError("connection closed before response head")
+        buf += d
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split()[1])
+    clen = 0
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        if k.strip().lower() == "content-length":
+            clen = int(v)
+    while len(rest) < clen:
+        d = s.recv(4096)
+        if not d:
+            raise OSError("connection closed mid-body")
+        rest += d
+    return status, rest[:clen]
+
+
+def _wait_frames(srv, n, timeout=15):
+    t0 = time.time()
+    while len(srv.frames) < n:
+        assert time.time() - t0 < timeout, \
+            f"upstream saw {len(srv.frames)}/{n} frames"
+        time.sleep(0.01)
+
+
+def test_out_of_order_ack_demux():
+    """Two pipelined flushes acked in REVERSE order: each client still
+    receives exactly its own slot's response (demux is by flush id, not
+    arrival order)."""
+    from etcd_tpu.server import batchframe
+    done = threading.Event()
+
+    def script(srv, idx, sock, rfile, head):
+        sock.sendall(batchframe.handshake_response())
+        f1 = srv.read_frame(idx, rfile)
+        f2 = srv.read_frame(idx, rfile)
+        for fid, items in (f2, f1):          # reverse order on purpose
+            srv.ack(sock, fid, [
+                (200, json.dumps({"echo": it["path"]}).encode() + b"\n")
+                for it in items])
+        done.wait(30)
+
+    srv = _FakeFrameUpstream(script)
+    ing = Ingress(IngressConfig(upstream=srv.url, flush_max_requests=1,
+                                flush_window=2, upstream_mode="frame"))
+    ing.start()
+    c1 = c2 = None
+    try:
+        c1 = _raw_put(ing.port, 0, "/ooo/a", "1")
+        _wait_frames(srv, 1)     # flush 1 is in flight before flush 2
+        c2 = _raw_put(ing.port, 0, "/ooo/b", "2")
+        _wait_frames(srv, 2)
+        st2, body2 = _read_http_response(c2)
+        st1, body1 = _read_http_response(c1)
+        assert (st1, json.loads(body1)["echo"]) == (200, "/ooo/a")
+        assert (st2, json.loads(body2)["echo"]) == (200, "/ooo/b")
+        assert [fid for _, fid, _ in srv.frames] == [1, 2]
+    finally:
+        done.set()
+        for c in (c1, c2):
+            if c is not None:
+                c.close()
+        ing.stop()
+        srv.close()
+
+
+def test_midwindow_sever_503s_exactly_inflight():
+    """The upstream dies with two flushes in the window, having acked
+    only the first: the acked client keeps its 200, the unacked one
+    gets a 503, and after reconnect the next flush carries ONLY new
+    writes — the severed flush is never re-sent (double-apply/CAS
+    hazard)."""
+    from etcd_tpu.server import batchframe, obs
+
+    def script(srv, idx, sock, rfile, head):
+        sock.sendall(batchframe.handshake_response())
+        if idx == 0:
+            f1 = srv.read_frame(idx, rfile)
+            srv.read_frame(idx, rfile)       # flush 2: never acked
+            srv.ack(sock, f1[0], [(200, b'{"ok": 1}\n')])
+            time.sleep(0.1)                  # let the ack land first
+            return                           # abrupt close = sever
+        while True:                          # the reconnect channel
+            f = srv.read_frame(idx, rfile)
+            if f is None:
+                return
+            srv.ack(sock, f[0], [
+                (200, b'{"ok": 2}\n') for _ in f[1]])
+
+    srv = _FakeFrameUpstream(script)
+    ing = Ingress(IngressConfig(upstream=srv.url, flush_max_requests=1,
+                                flush_window=2, upstream_mode="frame"))
+    ing.start()
+    conns = []
+    try:
+        n_sev = obs.ingress_upstream_severed.value
+        n_rec = obs.ingress_upstream_reconnects.value
+        c1 = _raw_put(ing.port, 0, "/sev/a", "1")
+        conns.append(c1)
+        _wait_frames(srv, 1)
+        c2 = _raw_put(ing.port, 0, "/sev/b", "2")
+        conns.append(c2)
+        _wait_frames(srv, 2)
+        st1, body1 = _read_http_response(c1)
+        assert st1 == 200 and json.loads(body1)["ok"] == 1
+        st2, body2 = _read_http_response(c2)
+        assert st2 == 503, (st2, body2)
+        assert "severed" in json.loads(body2)["cause"]
+        assert obs.ingress_upstream_severed.value == n_sev + 1
+
+        time.sleep(0.3)          # past the 0.05s reconnect backoff
+        c3 = _raw_put(ing.port, 0, "/sev/c", "3")
+        conns.append(c3)
+        st3, _body3 = _read_http_response(c3)
+        assert st3 == 200
+        assert obs.ingress_upstream_reconnects.value > n_rec
+        # The reconnect channel saw ONLY the new write: no retry of the
+        # severed flush.
+        replayed = [it["path"] for cidx, _, items in srv.frames
+                    if cidx == 1 for it in items]
+        assert replayed == ["/sev/c"], replayed
+    finally:
+        for c in conns:
+            c.close()
+        ing.stop()
+        srv.close()
+
+
+def test_auto_mode_falls_back_to_json_path():
+    """An upstream that routes /batch but refuses the batchframe
+    handshake (e.g. an older router): the lane flips to the round-10
+    JSON path — the SAME batch commits there, no client-visible error,
+    and the fallback is counted."""
+    from etcd_tpu.server import obs
+
+    def script(srv, idx, sock, rfile, head):
+        target = head[0].split(b" ")[1]
+        if b"batchframe" in target:
+            sock.sendall(b"HTTP/1.1 404 Not Found\r\n"
+                         b"Content-Length: 0\r\n\r\n")
+            return
+        # Minimal JSON /tenants/{t}/batch server (connection reuse).
+        while True:
+            clen = 0
+            for ln in head:
+                k, _, v = ln.partition(b":")
+                if k.strip().lower() == b"content-length":
+                    clen = int(v)
+            reqs = json.loads(rfile.read(clen))["reqs"]
+            results = [{"status": 201, "event":
+                        {"action": "set",
+                         "node": {"key": r["path"], "value": r["value"]}}}
+                       for r in reqs]
+            data = json.dumps({"results": results}).encode()
+            sock.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: application/json\r\n" +
+                         f"Content-Length: {len(data)}\r\n\r\n".encode()
+                         + data)
+            head = srv._read_head(rfile)
+            if head is None:
+                return
+
+    srv = _FakeFrameUpstream(script)
+    ing = Ingress(IngressConfig(upstream=srv.url,
+                                upstream_mode="auto"))
+    ing.start()
+    try:
+        n_fb = obs.ingress_upstream_fallbacks.value
+        c = _raw_put(ing.port, 0, "/fb/a", "1")
+        st, body = _read_http_response(c)
+        c.close()
+        assert st == 201, (st, body)
+        assert json.loads(body)["node"]["value"] == "1"
+        assert obs.ingress_upstream_fallbacks.value == n_fb + 1
+    finally:
+        ing.stop()
+        srv.close()
+
+
+def test_frame_fifo_across_flush_window(tmp_path):
+    """Per-client FIFO with flush_window > 1 against a REAL engine:
+    tiny flush caps force each client's sequential writes across many
+    pipelined flushes; every client must still observe monotone
+    modifiedIndex and its own value sequence in the store history."""
+    with stack(tmp_path, flush_max_requests=2, flush_window=4,
+               upstream_mode="frame") as s:
+        N_CLIENTS, N_WRITES = 12, 10
+        results = {}
+
+        def client(c):
+            t = c % G
+            out = []
+            for i in range(N_WRITES):
+                st, body = _put(s.base, t, f"/fifo/c{c}", f"v{c}_{i}")
+                out.append((st, body["node"]["modifiedIndex"]))
+            results[c] = out
+
+        ths = [threading.Thread(target=client, args=(c,))
+               for c in range(N_CLIENTS)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert all(not t.is_alive() for t in ths)
+        for c, out in results.items():
+            sts = [st for st, _ in out]
+            assert sts[0] == 201 and all(x == 200 for x in sts[1:]), sts
+            idxs = [i for _, i in out]
+            assert idxs == sorted(idxs) and len(set(idxs)) == len(idxs), \
+                (c, idxs)
+        # The channel really pipelined (frames went up) and nothing fell
+        # back to JSON.
+        sent = _scrape(s.base,
+                       'etcd_ingress_upstream_frames_total'
+                       '{direction="sent"}')
+        assert sent is not None and sent > 0
+        # Each client's final value survives.
+        for c in range(N_CLIENTS):
+            v = _get_json(f"{s.base}/tenants/{c % G}/v2/keys/fifo/c{c}"
+                          )["node"]["value"]
+            assert v == f"v{c}_{N_WRITES - 1}", (c, v)
+
+
+def test_pure_python_fallback_leg(tmp_path):
+    """use_native=False serves identically through the reference scan /
+    format path (the leg CI pins so the C extension never becomes
+    load-bearing): pipelined requests on one socket, then a real write."""
+    with stack(tmp_path, use_native=False) as s:
+        assert s.ing.use_native is False
+        # Two pipelined PUTs on one connection parse + dispatch in order.
+        c = socket.create_connection(("127.0.0.1", s.ing.port), timeout=30)
+        reqs = b""
+        for i in range(2):
+            body = f"value=p{i}".encode()
+            reqs += ((f"PUT /tenants/0/v2/keys/pyfb{i} HTTP/1.1\r\n"
+                      "Host: t\r\nContent-Type: "
+                      "application/x-www-form-urlencoded\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n"
+                      ).encode() + body)
+        c.sendall(reqs)
+        for i in range(2):
+            st, body = _read_http_response(c)
+            assert st == 201, (i, st, body)
+        c.close()
+        assert _scrape(s.base, "etcd_ingress_native_enabled") == 0.0

@@ -120,3 +120,80 @@ def test_pack_multi_byte_identical():
         pack_multi([(1, b"")], P_MULTI)   # payload must carry a tag byte
     with pytest.raises(TypeError):
         pack_multi([1], P_MULTI)
+
+
+# ---------------------------------------------------------------------------
+# ingresscore: the ingress tier's HTTP scan/format hot loop
+# ---------------------------------------------------------------------------
+
+_SCAN_CASES = [
+    b"",
+    b"GET /health HTTP/1.1\r\n\r\n",
+    (b"PUT /tenants/1/v2/keys/a?x=1 HTTP/1.1\r\n"
+     b"Content-Length: 5\r\n"
+     b"Content-Type: application/x-www-form-urlencoded\r\n"
+     b"Authorization: Basic abc=\r\nConnection: close\r\n\r\nvalue"),
+    # second request's body incomplete: only the first is emitted
+    b"PUT /a HTTP/1.1\r\nContent-Length: 5\r\n\r\nvalueP"
+    b"UT /b HTTP/1.1\r\nContent-Length: 5\r\n\r\nva",
+    # two complete pipelined requests, case-insensitive close
+    b"GET /a HTTP/1.1\r\n\r\nGET /b HTTP/1.1\r\nconnection: CLOSE\r\n\r\n",
+    b"BADLINE\r\n\r\n",                                  # err: request line
+    b"GET /a HTTP/1.1\r\nContent-Length: zz\r\n\r\n",    # err: bad length
+    b"GET /a HTTP/1.1\r\nContent-Length: 99999999999\r\n\r\n",  # err: body
+    b"GET /a HTTP/1.1\r\nContent-Length:\r\n\r\n",       # empty reads as 0
+    b"X" * (65 * 1024),                                  # err: headers cap
+    b"GET /a HTTP/1.1\r\nNo-colon-line junk\r\nAuthorization:  pad  \r\n\r\n",
+]
+
+
+def test_py_scan_requests_semantics():
+    from etcd_tpu.native import (ING_EBADLINE, ING_OK, _py_scan_requests)
+    reqs, consumed, err = _py_scan_requests(_SCAN_CASES[2])
+    assert err == ING_OK and consumed == len(_SCAN_CASES[2])
+    m, t, ctype, auth, close, body = reqs[0]
+    assert (m, t) == ("PUT", "/tenants/1/v2/keys/a?x=1")
+    assert ctype.startswith("application/x-www-form")
+    assert auth == "Basic abc=" and close and body == b"value"
+    # a bad request line consumes nothing past the last good request
+    reqs, consumed, err = _py_scan_requests(_SCAN_CASES[5])
+    assert err == ING_EBADLINE and reqs == [] and consumed == 0
+
+
+@pytest.mark.skipif(not native.HAVE_NATIVE_INGRESS,
+                    reason="ingresscore not built (./build)")
+def test_native_scan_requests_matches_python():
+    from etcd_tpu.native import _c_scan_requests, _py_scan_requests
+    for case in _SCAN_CASES:
+        assert _c_scan_requests(bytes(case)) == _py_scan_requests(case), case
+    # bytearray input (the live rbuf shape) via the wrapper
+    got = native.scan_requests(bytearray(_SCAN_CASES[4]))
+    assert got == _py_scan_requests(_SCAN_CASES[4])
+
+
+@pytest.mark.skipif(not native.HAVE_NATIVE_INGRESS,
+                    reason="ingresscore not built (./build)")
+def test_native_format_responses_matches_python():
+    from etcd_tpu.native import _c_format_responses, _py_format_responses
+    items = [(200, b'{"ok":1}\n'), (201, b""), (503, b"{}"),
+             (412, b"precondition"), (777, b"unknown-status")]
+    c = _c_format_responses(items)
+    assert c == _py_format_responses(items)
+    # parseable by the stdlib's strict parser
+    import io
+    from http.client import HTTPResponse
+
+    class _FakeSock:
+        def __init__(self, data):
+            self._f = io.BytesIO(data)
+
+        def makefile(self, *a, **k):
+            return self._f
+
+    r = HTTPResponse(_FakeSock(c[0]))  # type: ignore[arg-type]
+    r.begin()
+    assert r.status == 200 and r.read() == b'{"ok":1}\n'
+    with pytest.raises(TypeError):
+        _c_format_responses([(200, "not-bytes")])
+    with pytest.raises(TypeError):
+        _c_format_responses([200])
